@@ -1,0 +1,27 @@
+"""deeprest_tpu — a TPU-native API-aware resource-estimation framework.
+
+A ground-up JAX/XLA re-design of the capabilities of IBM/DeepRest
+(EuroSys'22, reference at /root/reference): learning the causal mapping
+from API traffic (distributed-trace call-path features) to per-component
+resource utilization, with what-if capacity estimation and anomaly
+detection on top.
+
+Package layout
+--------------
+- ``data``      raw-telemetry contract, call-path featurization, windowing,
+                normalization statistics, trace synthesis (what-if inputs).
+- ``ops``       TPU compute primitives: scan-based (and Pallas) GRU with
+                hoisted input projections, pinball (quantile) loss.
+- ``models``    the multi-task quantile GRU estimator (stacked experts) and
+                the two reference baselines (resource-aware ANN,
+                component-aware linear scaler).
+- ``train``     jit-compiled training/eval loops, Orbax checkpointing,
+                metrics (MAE percentile reports, steps/sec).
+- ``parallel``  device-mesh construction and sharding rules (data / expert /
+                feature-model axes) for pjit/GSPMD execution over ICI.
+- ``workload``  the capability harness: scenario-driven workload/telemetry
+                simulator producing training corpora at DeathStarBench scale.
+- ``serve``     trained-model export and what-if serving.
+"""
+
+__version__ = "0.1.0"

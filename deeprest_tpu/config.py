@@ -1,0 +1,137 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters its knobs across module-level constant blocks
+(reference: resource-estimation/estimate.py:13-18, featurize.py:6-7,
+qrnn.py:7-8, locust/locustfile-*.py:14-23).  Here every knob is a field on a
+frozen dataclass so configs are explicit, serializable, and hashable enough
+to key jit caches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Dimensions of the multi-task quantile GRU.
+
+    Defaults mirror the reference model (reference:
+    resource-estimation/qrnn.py:7-8 — hidden 128, 1 layer, bidirectional,
+    quantiles (.05, .50, .95), dropout 0.5).
+    """
+
+    feature_dim: int = 8          # padded call-path feature capacity |M|
+    num_metrics: int = 3          # number of component_resource targets (experts)
+    hidden_size: int = 128
+    num_layers: int = 1
+    bidirectional: bool = True
+    quantiles: tuple[float, ...] = (0.05, 0.50, 0.95)
+    dropout_rate: float = 0.50
+    # bfloat16 matmuls on the MXU; params and loss stay float32.
+    compute_dtype: str = "float32"
+
+    @property
+    def directions(self) -> int:
+        return 2 if self.bidirectional else 1
+
+    @property
+    def rnn_out_dim(self) -> int:
+        return self.hidden_size * self.directions
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training-loop knobs (reference: resource-estimation/estimate.py:13-18)."""
+
+    num_epochs: int = 50
+    batch_size: int = 32
+    learning_rate: float = 1e-3
+    train_split: float = 0.40     # leading fraction of windows used for training
+    window_size: int = 60         # sliding-window length (time steps)
+    eval_stride: int = 60         # test windows sampled every `stride` steps
+    eval_max_cycles: int = 9      # cap on evaluated test windows per epoch
+    seed: int = 0
+    checkpoint_dir: str | None = None
+    checkpoint_every_epochs: int = 10
+    log_every_steps: int = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class FeaturizeConfig:
+    """Call-path feature-space construction.
+
+    The raw feature space is unbounded (one dimension per observed
+    root-to-node call path; reference: resource-estimation/featurize.py:11-24).
+    XLA wants static shapes, so the vector is materialized at a fixed
+    ``capacity``; ``hash_features=True`` switches from a growable dictionary
+    to stable hash-bucketing so streaming corpora never force a recompile.
+    """
+
+    capacity: int = 0             # 0 = size to the observed space, rounded up
+    round_to: int = 128           # pad capacity to a multiple (MXU lane width)
+    hash_features: bool = False
+    hash_seed: int = 0x5EED
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device-mesh shape for pjit/GSPMD execution.
+
+    Axes: ``data`` shards the batch (DP over ICI), ``expert`` shards the
+    stacked per-metric experts (EP), ``model`` shards the feature/hidden
+    dimensions of the mask and GRU projections (TP) for huge call-path
+    spaces.  Pipeline/sequence parallelism are deliberately N/A for this
+    model family (window length 60, recurrent core; SURVEY.md §2.5/§5.7).
+    """
+
+    data: int = 1
+    expert: int = 1
+    model: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.expert * self.model
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    featurize: FeaturizeConfig = dataclasses.field(default_factory=FeaturizeConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    def replace(self, **sections: Any) -> "Config":
+        return dataclasses.replace(self, **sections)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Config":
+        def build(tp, section):
+            known = {f.name for f in dataclasses.fields(tp)}
+            kwargs = dict(section)
+            unknown = set(kwargs) - known
+            if unknown:
+                raise ValueError(
+                    f"unknown {tp.__name__} keys: {sorted(unknown)} "
+                    f"(known: {sorted(known)})"
+                )
+            for k, v in kwargs.items():
+                if isinstance(v, list):
+                    kwargs[k] = tuple(v)
+            return tp(**kwargs)
+
+        return cls(
+            model=build(ModelConfig, d.get("model", {})),
+            train=build(TrainConfig, d.get("train", {})),
+            featurize=build(FeaturizeConfig, d.get("featurize", {})),
+            mesh=build(MeshConfig, d.get("mesh", {})),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))

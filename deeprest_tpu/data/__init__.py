@@ -1,0 +1,30 @@
+"""Host-side data plane: telemetry contract, featurization, windowing."""
+
+from deeprest_tpu.data.schema import Span, MetricSample, Bucket, load_raw_data
+from deeprest_tpu.data.featurize import (
+    CallPathSpace,
+    featurize_buckets,
+    count_invocations,
+)
+from deeprest_tpu.data.windows import (
+    sliding_windows,
+    MinMaxStats,
+    minmax_fit,
+    minmax_apply,
+    minmax_invert,
+)
+
+__all__ = [
+    "Span",
+    "MetricSample",
+    "Bucket",
+    "load_raw_data",
+    "CallPathSpace",
+    "featurize_buckets",
+    "count_invocations",
+    "sliding_windows",
+    "MinMaxStats",
+    "minmax_fit",
+    "minmax_apply",
+    "minmax_invert",
+]

@@ -1,0 +1,245 @@
+"""Call-path featurization: span trees → fixed-width count vectors.
+
+Semantics follow the reference's feature construction (reference:
+resource-estimation/featurize.py:11-57): every root-to-node *call path*
+observed in any trace becomes one feature dimension, and a bucket's feature
+vector counts how many times each path occurs across the bucket's traces.
+Per-component invocation counts (plus a synthetic ``general`` stream counting
+whole traces) feed the component-aware baseline.
+
+TPU-first departures from the reference:
+
+- **Static width.**  The raw space is unbounded; XLA wants static shapes.
+  Vectors are materialized at a fixed ``capacity`` (rounded up to an MXU-lane
+  multiple) so a growing vocabulary never changes array shapes mid-run.
+- **Hash-bucketing mode.**  For streaming/10k-endpoint corpora the dictionary
+  is replaced by a stable BLAKE2 hash of the call path into ``capacity``
+  buckets: no global vocabulary pass, no recompile, multi-host consistent.
+- **Streaming API.**  ``observe``/``extract`` work bucket-at-a-time so the
+  continuous-retrain mode can featurize a live firehose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.schema import Bucket, Span
+
+CallPath = tuple[str, ...]
+
+
+def _stable_hash(path: CallPath, seed: int) -> int:
+    h = hashlib.blake2b(
+        "\x1f".join(path).encode("utf-8"),
+        digest_size=8,
+        key=seed.to_bytes(8, "little", signed=False),
+    )
+    return int.from_bytes(h.digest(), "little")
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return max(n, 1)
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+@dataclasses.dataclass
+class CallPathSpace:
+    """The feature space M: call path → column index.
+
+    In dictionary mode indices are assigned in first-observed order, matching
+    the reference's growth rule (reference: resource-estimation/
+    featurize.py:14-15) so vocabularies are reproducible for a fixed corpus
+    order.  In hash mode indices are ``stable_hash(path) % capacity`` and the
+    space never needs fitting.
+    """
+
+    config: FeaturizeConfig = dataclasses.field(default_factory=FeaturizeConfig)
+    index: dict[CallPath, int] = dataclasses.field(default_factory=dict)
+    # Set on first extract (or explicit freeze()); afterwards the vector
+    # width never changes even if the vocabulary keeps growing.
+    frozen_capacity: int | None = None
+
+    # -- construction ------------------------------------------------------
+
+    def observe(self, buckets_or_traces: Iterable[Bucket] | Iterable[Span]) -> "CallPathSpace":
+        """Grow the vocabulary from buckets (or bare traces). No-op in hash mode."""
+        if self.config.hash_features:
+            return self
+        for item in buckets_or_traces:
+            traces = item.traces if isinstance(item, Bucket) else [item]
+            for trace in traces:
+                for path, _ in trace.walk():
+                    if path not in self.index:
+                        self.index[path] = len(self.index)
+        return self
+
+    @classmethod
+    def fit(cls, buckets: Iterable[Bucket], config: FeaturizeConfig | None = None) -> "CallPathSpace":
+        return cls(config=config or FeaturizeConfig()).observe(buckets)
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def num_observed(self) -> int:
+        return len(self.index)
+
+    @property
+    def capacity(self) -> int:
+        """Static feature-vector width (the model's input dimension).
+
+        Frozen at the first extraction so a vocabulary that keeps growing
+        can never change array shapes mid-run (it overflows instead).
+        """
+        if self.frozen_capacity is not None:
+            return self.frozen_capacity
+        cfg = self.config
+        if cfg.capacity > 0:
+            return cfg.capacity
+        return _round_up(max(self.num_observed, 1), cfg.round_to)
+
+    def freeze(self) -> "CallPathSpace":
+        """Pin the current capacity as the permanent vector width."""
+        if self.frozen_capacity is None:
+            self.frozen_capacity = self.capacity
+        return self
+
+    def column_of(self, path: CallPath) -> int | None:
+        if self.config.hash_features:
+            return _stable_hash(path, self.config.hash_seed) % self.capacity
+        idx = self.index.get(path)
+        if idx is None or idx >= self.capacity:
+            return None
+        return idx
+
+    # -- extraction --------------------------------------------------------
+
+    def extract(self, traces: Sequence[Span], out: np.ndarray | None = None) -> np.ndarray:
+        """Count each call path across ``traces`` into a [capacity] vector.
+
+        Freezes the capacity on first call.  A caller-supplied ``out`` buffer
+        is zeroed first (counts are per-call, never cumulative).  Paths beyond
+        a fixed ``capacity`` in dictionary mode are dropped (counted into
+        nothing) — the documented overflow policy; size the capacity or switch
+        to hashing to avoid it.
+        """
+        self.freeze()
+        if out is not None:
+            out[:] = 0.0
+            x = out
+        else:
+            x = np.zeros((self.capacity,), dtype=np.float32)
+        for trace in traces:
+            for path, _ in trace.walk():
+                col = self.column_of(path)
+                if col is not None:
+                    x[col] += 1.0
+        return x
+
+    def extract_buckets(self, buckets: Sequence[Bucket]) -> np.ndarray:
+        """[num_buckets, capacity] traffic matrix."""
+        self.freeze()
+        out = np.zeros((len(buckets), self.capacity), dtype=np.float32)
+        for t, bucket in enumerate(buckets):
+            self.extract(bucket.traces, out=out[t])
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    def vocabulary(self) -> list[CallPath]:
+        """Observed call paths in column order (dictionary mode only)."""
+        return sorted(self.index, key=self.index.__getitem__)
+
+    def endpoints(self) -> list[str]:
+        """Root-level API endpoints (length-1 call paths) observed so far."""
+        return [p[0] for p in self.vocabulary() if len(p) == 1]
+
+
+# --------------------------------------------------------------------------
+# Invocation counts (component-aware baseline input)
+
+
+def count_invocations(traces: Sequence[Span]) -> dict[str, int]:
+    """Per-component span counts in a bucket, plus ``general`` = #traces.
+
+    (reference: resource-estimation/featurize.py:43-57)
+    """
+    counts: dict[str, int] = {"general": 0}
+    for trace in traces:
+        counts["general"] += 1
+        for _, node in trace.walk():
+            counts[node.component] = counts.get(node.component, 0) + 1
+    return counts
+
+
+@dataclasses.dataclass
+class FeaturizedData:
+    """The model-ready triple the reference pickles as ``input.pkl``
+    (reference: resource-estimation/featurize.py:104-106)."""
+
+    traffic: np.ndarray                    # [T, capacity] float32 path counts
+    resources: dict[str, np.ndarray]       # metric key → [T] float32
+    invocations: dict[str, np.ndarray]     # component → [T] float32
+    space: CallPathSpace
+
+    @property
+    def metric_names(self) -> list[str]:
+        return list(self.resources)
+
+    def targets(self) -> np.ndarray:
+        """[T, num_metrics] resource matrix in metric_names order."""
+        return np.stack([self.resources[k] for k in self.metric_names], axis=-1)
+
+
+def featurize_buckets(
+    buckets: Sequence[Bucket],
+    config: FeaturizeConfig | None = None,
+    space: CallPathSpace | None = None,
+) -> FeaturizedData:
+    """Full-corpus featurization: traffic, resources, invocation counts."""
+    config = config or FeaturizeConfig()
+    if space is None:
+        space = CallPathSpace.fit(buckets, config)
+
+    traffic = space.extract_buckets(buckets)
+
+    # Resource series must stay time-aligned with traffic: every bucket has to
+    # carry exactly the metric keys of the union, or series would silently
+    # shift against the traffic rows.
+    resources: dict[str, list[float]] = {}
+    expected_keys: set[str] | None = None
+    for t, bucket in enumerate(buckets):
+        seen: set[str] = set()
+        for m in bucket.metrics:
+            if m.key in seen:
+                raise ValueError(f"bucket {t}: duplicate metric {m.key!r}")
+            seen.add(m.key)
+            resources.setdefault(m.key, []).append(m.value)
+        if expected_keys is None:
+            expected_keys = seen
+        elif seen != expected_keys:
+            missing, extra = expected_keys - seen, seen - expected_keys
+            raise ValueError(
+                f"bucket {t}: metric keys diverge from bucket 0 "
+                f"(missing={sorted(missing)}, new={sorted(extra)}); every "
+                "bucket must carry the same metrics or series misalign"
+            )
+
+    per_bucket_counts = [count_invocations(b.traces) for b in buckets]
+    components = {c for counts in per_bucket_counts for c in counts}
+    invocations: dict[str, list[float]] = {c: [] for c in components | {"general"}}
+    for c in per_bucket_counts:
+        for comp in invocations:
+            invocations[comp].append(float(c.get(comp, 0)))
+
+    return FeaturizedData(
+        traffic=traffic,
+        resources={k: np.asarray(v, dtype=np.float32) for k, v in resources.items()},
+        invocations={k: np.asarray(v, dtype=np.float32) for k, v in invocations.items()},
+        space=space,
+    )

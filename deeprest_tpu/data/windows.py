@@ -1,0 +1,108 @@
+"""Sliding windows and train-split normalization statistics.
+
+Replicates the reference's windowing (reference: resource-estimation/
+utils.py:4-5 — note the last ``len(ts) - window`` start offset is exclusive)
+and its min-max normalization computed on the *training split only*
+(reference: resource-estimation/qrnn.py:69-75), but keeps the statistics as
+explicit, serializable state so train/eval/serving all share one source of
+truth instead of re-deriving scales inline (SURVEY.md §7.3 calls this out as
+an easy silent-wrongness spot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+
+def sliding_windows(ts: np.ndarray, window_size: int) -> np.ndarray:
+    """[T, ...] → [T - window_size, window_size, ...] overlapping windows.
+
+    A zero-copy strided view (the reference builds a Python list of slices);
+    callers treat it as read-only or copy.
+    """
+    n = len(ts) - window_size
+    if n <= 0:
+        raise ValueError(
+            f"series of length {len(ts)} too short for window_size={window_size}"
+        )
+    view = np.lib.stride_tricks.sliding_window_view(ts, window_size, axis=0)
+    # sliding_window_view puts the window axis last; move it after the time
+    # axis and drop the final start offset to match reference semantics.
+    view = np.moveaxis(view, -1, 1)
+    return view[:n]
+
+
+@dataclasses.dataclass
+class MinMaxStats:
+    """Min-max scale state: ``x_norm = (x - min) / (max - min)``.
+
+    Degenerate ranges (max == min) pass values through unchanged, matching
+    the reference's guard (reference: resource-estimation/qrnn.py:72-74).
+    Stored per-metric as arrays so one object scales the whole [.., E] target
+    tensor at once.
+    """
+
+    min: np.ndarray   # broadcastable to the scaled tensor
+    max: np.ndarray
+
+    @property
+    def range(self) -> np.ndarray:
+        return self.max - self.min
+
+    @property
+    def _safe_range(self) -> np.ndarray:
+        r = self.range
+        return np.where(r == 0.0, 1.0, r)
+
+    def apply(self, x: np.ndarray) -> np.ndarray:
+        return np.where(self.range == 0.0, x, (x - self.min) / self._safe_range)
+
+    def invert(self, x: np.ndarray) -> np.ndarray:
+        return np.where(self.range == 0.0, x, x * self.range + self.min)
+
+    def to_dict(self) -> dict:
+        return {"min": np.asarray(self.min).tolist(), "max": np.asarray(self.max).tolist()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MinMaxStats":
+        return cls(
+            min=np.asarray(d["min"], dtype=np.float32),
+            max=np.asarray(d["max"], dtype=np.float32),
+        )
+
+
+def minmax_fit(x: np.ndarray, split: int, axis: Sequence[int] | None = None) -> MinMaxStats:
+    """Fit stats on ``x[:split]``.
+
+    ``axis=None`` reduces over everything (the reference's treatment of the
+    traffic tensor); pass the reduction axes to keep per-metric scales for
+    the target tensor (the reference loops metrics one at a time —
+    reference: resource-estimation/estimate.py:42-47).
+    """
+    train = x[:split]
+    if axis is None:
+        mn = np.asarray(np.min(train), dtype=np.float32)
+        mx = np.asarray(np.max(train), dtype=np.float32)
+    else:
+        axis = tuple(axis)
+        if 0 not in axis:
+            raise ValueError(
+                f"axis={axis} must include the leading (time/window) axis 0; "
+                "stats are fit over the train split"
+            )
+        mn = np.min(train, axis=axis, keepdims=True).astype(np.float32)
+        mx = np.max(train, axis=axis, keepdims=True).astype(np.float32)
+        # drop the leading (time) keepdim so stats broadcast over any batch rank
+        mn, mx = mn[0], mx[0]
+    return MinMaxStats(min=mn, max=mx)
+
+
+def minmax_apply(x: np.ndarray, stats: MinMaxStats) -> np.ndarray:
+    return stats.apply(x)
+
+
+def minmax_invert(x: np.ndarray, stats: MinMaxStats) -> np.ndarray:
+    return stats.invert(x)

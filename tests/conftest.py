@@ -1,0 +1,89 @@
+"""Test env: force CPU with 8 virtual devices so sharding tests run anywhere.
+
+Must run before the first ``import jax`` anywhere in the test process
+(SURVEY.md §4: CPU device-mesh simulation stands in for the reference's
+absent distributed tests).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.data.schema import Bucket, MetricSample, Span
+
+
+def _span(component, operation, *children):
+    return Span(component=component, operation=operation, children=list(children))
+
+
+def make_toy_buckets(num_buckets: int = 3, seed: int = 0) -> list[Bucket]:
+    """A small corpus shaped like the documented raw-data contract
+    (reference: resource-estimation/README.md:29-63): a write path with
+    fan-out and a flat read path, with per-bucket metric series."""
+    rng = np.random.default_rng(seed)
+    buckets = []
+    for t in range(num_buckets):
+        n_compose = int(rng.integers(1, 4))
+        n_read = int(rng.integers(1, 4))
+        traces = []
+        for i in range(n_compose):
+            compose = _span(
+                "gateway", "/compose",
+                _span("compose-svc", "/compose",
+                      _span("text-svc", "/decode"),
+                      _span("store-svc", "/store",
+                            _span("store-db", "/insert")),
+                      *([_span("media-svc", "/upload")] if (t + i) % 2 == 0 else [])),
+            )
+            traces.append(compose)
+        for _ in range(n_read):
+            traces.append(
+                _span("gateway", "/read",
+                      _span("timeline-svc", "/read",
+                            _span("store-svc", "/find")))
+            )
+        metrics = [
+            MetricSample("gateway", "cpu", 0.5 + 0.1 * n_compose + 0.05 * n_read),
+            MetricSample("gateway", "memory", 0.8 + 0.01 * t),
+            MetricSample("store-db", "wiops", 100.0 * n_compose),
+        ]
+        buckets.append(Bucket(metrics=metrics, traces=traces))
+    return buckets
+
+
+@pytest.fixture
+def toy_buckets() -> list[Bucket]:
+    return make_toy_buckets()
+
+
+def make_series_buckets(num_buckets: int, seed: int = 0) -> list[Bucket]:
+    """A longer corpus with traffic-correlated resource values, long enough
+    for windowed training (used by trainer/e2e tests)."""
+    rng = np.random.default_rng(seed)
+    buckets = []
+    for t in range(num_buckets):
+        load = 2.0 + np.sin(2 * np.pi * t / 24.0) + rng.uniform(-0.2, 0.2)
+        n_compose = max(0, int(rng.poisson(load)))
+        n_read = max(0, int(rng.poisson(2 * load)))
+        traces = [
+            _span("gateway", "/compose",
+                  _span("store-svc", "/store", _span("store-db", "/insert")))
+            for _ in range(n_compose)
+        ] + [
+            _span("gateway", "/read", _span("store-svc", "/find"))
+            for _ in range(n_read)
+        ]
+        metrics = [
+            MetricSample("gateway", "cpu",
+                         10.0 * n_compose + 3.0 * n_read + rng.normal(0, 0.5)),
+            MetricSample("store-db", "wiops",
+                         25.0 * n_compose + rng.normal(0, 1.0)),
+        ]
+        buckets.append(Bucket(metrics=metrics, traces=traces))
+    return buckets

@@ -1,0 +1,116 @@
+"""Featurization invariants (SURVEY.md §4: path-space determinism, count
+correctness, contract compatibility with the reference's toy fixture)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.featurize import CallPathSpace, count_invocations, featurize_buckets
+from deeprest_tpu.data.schema import Bucket, Span, load_raw_data
+
+from conftest import make_toy_buckets
+
+REFERENCE_TOY = "/root/reference/resource-estimation/raw_data.pkl"
+
+
+def total_spans(bucket: Bucket) -> int:
+    return sum(1 for trace in bucket.traces for _ in trace.walk())
+
+
+def test_path_space_deterministic(toy_buckets):
+    a = CallPathSpace.fit(toy_buckets)
+    b = CallPathSpace.fit(make_toy_buckets())
+    assert a.vocabulary() == b.vocabulary()
+    assert a.index == b.index
+
+
+def test_first_seen_order(toy_buckets):
+    space = CallPathSpace.fit(toy_buckets)
+    vocab = space.vocabulary()
+    # Root of the first trace must be feature 0 (reference growth rule:
+    # resource-estimation/featurize.py:14-15).
+    assert vocab[0] == ("gateway_/compose",)
+    # Depth-first: a child path appears after its parent.
+    for path in vocab:
+        if len(path) > 1:
+            assert space.index[path[:-1]] < space.index[path]
+
+
+def test_extract_counts_every_span_once(toy_buckets):
+    space = CallPathSpace.fit(toy_buckets)
+    for bucket in toy_buckets:
+        x = space.extract(bucket.traces)
+        assert x.sum() == total_spans(bucket)
+
+
+def test_extract_known_counts():
+    tree = Span("a", "/op", [Span("b", "/x", []), Span("b", "/x", [])])
+    space = CallPathSpace.fit([Bucket(traces=[tree])])
+    x = space.extract([tree, tree])
+    assert x[space.index[("a_/op",)]] == 2
+    assert x[space.index[("a_/op", "b_/x")]] == 4
+    assert space.num_observed == 2
+
+
+def test_capacity_rounding(toy_buckets):
+    space = CallPathSpace.fit(toy_buckets, FeaturizeConfig(round_to=128))
+    assert space.capacity == 128
+    space2 = CallPathSpace.fit(toy_buckets, FeaturizeConfig(capacity=16))
+    assert space2.capacity == 16
+
+
+def test_overflow_drops_beyond_capacity():
+    buckets = [Bucket(traces=[Span("c", f"/op{i}") for i in range(10)])]
+    space = CallPathSpace.fit(buckets, FeaturizeConfig(capacity=4))
+    x = space.extract(buckets[0].traces)
+    assert x.shape == (4,)
+    assert x.sum() == 4  # 6 of 10 paths overflow and are dropped
+
+
+def test_hash_mode_stable_and_fitless(toy_buckets):
+    cfg = FeaturizeConfig(capacity=64, hash_features=True)
+    a = CallPathSpace(config=cfg)
+    b = CallPathSpace(config=cfg)
+    for bucket in toy_buckets:
+        np.testing.assert_array_equal(a.extract(bucket.traces), b.extract(bucket.traces))
+    # All spans still counted (hash mode never drops, only collides).
+    assert a.extract(toy_buckets[0].traces).sum() == total_spans(toy_buckets[0])
+    # Different seed → different layout.
+    c = CallPathSpace(config=FeaturizeConfig(capacity=64, hash_features=True, hash_seed=7))
+    assert any(
+        not np.array_equal(a.extract(bk.traces), c.extract(bk.traces))
+        for bk in toy_buckets
+    )
+
+
+def test_count_invocations():
+    tree = Span("a", "/op", [Span("b", "/x", []), Span("b", "/y", [Span("a", "/z", [])])])
+    c = count_invocations([tree, tree])
+    assert c == {"general": 2, "a": 4, "b": 4}
+
+
+def test_featurize_buckets_shapes(toy_buckets):
+    data = featurize_buckets(toy_buckets, FeaturizeConfig(round_to=1))
+    T = len(toy_buckets)
+    assert data.traffic.shape == (T, data.space.capacity)
+    assert set(data.resources) == {"gateway_cpu", "gateway_memory", "store-db_wiops"}
+    for series in data.resources.values():
+        assert series.shape == (T,)
+    assert "general" in data.invocations
+    assert data.targets().shape == (T, 3)
+    # invocations['general'] counts whole traces
+    for t, bucket in enumerate(toy_buckets):
+        assert data.invocations["general"][t] == len(bucket.traces)
+
+
+@pytest.mark.skipif(not os.path.exists(REFERENCE_TOY), reason="reference fixture absent")
+def test_reference_toy_contract_compat():
+    buckets = load_raw_data(REFERENCE_TOY)
+    assert len(buckets) == 3
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=1))
+    assert set(data.resources) == {"nginx-thrift_cpu", "nginx-thrift_memory", "media-mongodb_wiops"}
+    for t, bucket in enumerate(buckets):
+        assert data.traffic[t].sum() == total_spans(bucket)
+    assert data.space.endpoints()  # root endpoints discovered

@@ -1,0 +1,67 @@
+"""Windowing + normalization-stat golden tests (SURVEY.md §7.3: per-metric
+scales threaded as state are an easy silent-wrongness spot)."""
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
+
+
+def naive_windows(ts, w):
+    return np.asarray([ts[i:i + w] for i in range(len(ts) - w)])
+
+
+def test_sliding_windows_matches_reference_semantics():
+    ts = np.arange(20, dtype=np.float32)
+    np.testing.assert_array_equal(sliding_windows(ts, 5), naive_windows(ts, 5))
+
+
+def test_sliding_windows_multidim():
+    ts = np.random.default_rng(0).normal(size=(30, 4)).astype(np.float32)
+    got = sliding_windows(ts, 7)
+    assert got.shape == (23, 7, 4)
+    np.testing.assert_array_equal(got, naive_windows(ts, 7))
+
+
+def test_sliding_windows_too_short():
+    with pytest.raises(ValueError):
+        sliding_windows(np.zeros(5), 5)
+
+
+def test_minmax_global():
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0], [100.0, -5.0]], dtype=np.float32)
+    stats = minmax_fit(x, split=2)  # train split excludes the outlier row
+    assert stats.min == 1.0 and stats.max == 4.0
+    normed = stats.apply(x)
+    np.testing.assert_allclose(normed[:2], (x[:2] - 1.0) / 3.0)
+    np.testing.assert_allclose(stats.invert(normed), x, rtol=1e-6)
+
+
+def test_minmax_per_metric_axes():
+    rng = np.random.default_rng(1)
+    y = rng.normal(size=(50, 60, 3)).astype(np.float32)
+    stats = minmax_fit(y, split=20, axis=(0, 1))
+    assert stats.min.shape == (1, 3)
+    normed = stats.apply(y)
+    for m in range(3):
+        train = y[:20, :, m]
+        np.testing.assert_allclose(
+            normed[:20, :, m],
+            (train - train.min()) / (train.max() - train.min()),
+            rtol=1e-5,
+        )
+    np.testing.assert_allclose(stats.invert(normed), y, rtol=1e-4, atol=1e-5)
+
+
+def test_minmax_degenerate_range_passthrough():
+    x = np.full((10, 2), 3.0, dtype=np.float32)
+    stats = minmax_fit(x, split=5)
+    np.testing.assert_array_equal(stats.apply(x), x)
+    np.testing.assert_array_equal(stats.invert(x), x)
+
+
+def test_minmax_roundtrip_serialization():
+    stats = MinMaxStats(min=np.asarray([1.0, 2.0]), max=np.asarray([3.0, 2.0]))
+    restored = MinMaxStats.from_dict(stats.to_dict())
+    x = np.asarray([[2.0, 5.0]])
+    np.testing.assert_allclose(restored.apply(x), stats.apply(x))
