@@ -1,0 +1,6 @@
+"""Estimation models: the multi-task quantile GRU and the two baselines."""
+
+from deeprest_tpu.models.qrnn import QuantileGRU
+from deeprest_tpu.models.baselines import ResourceAwareBaseline, ComponentAwareBaseline
+
+__all__ = ["QuantileGRU", "ResourceAwareBaseline", "ComponentAwareBaseline"]

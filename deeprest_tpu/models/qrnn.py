@@ -1,0 +1,136 @@
+"""The estimator: a multi-task, attention-masked, quantile GRU.
+
+Capability parity with the reference model (reference:
+resource-estimation/qrnn.py:6-55): per metric (component×resource) one
+*expert* consisting of (a) a learned soft feature mask over the shared
+traffic features (the "attention-based API-call encoder"), (b) a
+bidirectional GRU over the time window, and (c) a quantile head fed with
+``concat(mean of all other experts' GRU outputs, own GRU output)`` — the
+cross-metric knowledge-sharing path.
+
+TPU-first re-design (not a translation):
+
+- **Experts are an array axis, not a ModuleList.**  All per-expert weights
+  carry a leading ``E`` axis, so the whole model is one set of batched
+  einsums — MXU-friendly, and expert parallelism is a sharding annotation
+  on axis 0 (SURVEY.md §2.5/§7.1).
+- **The mask is folded into the GRU input weights.**  ``(x ⊙ mask_e) @ W``
+  ≡ ``x @ (mask_e[:,None] ⊙ W)``, so the masked input is never materialized
+  per expert: the hoisted input projection reads ``x`` once — O(B·T·F)
+  HBM traffic instead of O(E·B·T·F).
+- **Cross-expert mixing is O(E), not O(E²).**  ``mean_{j≠i}(out_j)``
+  = ``(Σ_j out_j − out_i) / (E−1)`` — the all-pairs stack/mean the
+  reference materializes is computed from one global sum (SURVEY.md §7.3).
+
+Deviation (documented): for ``num_metrics == 1`` the reference's mean over
+the empty "others" set is undefined (it would crash); here the mix input
+falls back to the expert's own output.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deeprest_tpu.config import ModelConfig
+from deeprest_tpu.ops.gru import GRUParams, bidirectional_gru, gru
+
+
+class QuantileGRU(nn.Module):
+    """Multi-task quantile GRU.
+
+    Input ``[B, T, F]`` traffic-feature windows → output ``[B, T, E, Q]``
+    per-metric quantile predictions.
+    """
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        e, f, h, q = cfg.num_metrics, cfg.feature_dim, cfg.hidden_size, len(cfg.quantiles)
+        if x.shape[-1] != f:
+            raise ValueError(f"input feature dim {x.shape[-1]} != config.feature_dim {f}")
+        compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+        def uniform_pm(scale):
+            def _init(key, shape, dtype=jnp.float32):
+                return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+            return _init
+
+        # (a) learned soft feature mask — Linear(1→H) → ReLU → Linear(H→F)
+        # → softmax, driven by a constant 1.0 (reference: qrnn.py:20-26,33-36).
+        # Linear(1→H) on a constant input is just (weight + bias): one [E,H]
+        # pre-activation per expert.
+        k_in = 1.0  # fan_in of the constant input
+        mask_w1 = self.param("mask_w1", uniform_pm(1.0 / k_in ** 0.5), (e, h))
+        mask_b1 = self.param("mask_b1", uniform_pm(1.0 / k_in ** 0.5), (e, h))
+        k_h = 1.0 / h ** 0.5
+        mask_w2 = self.param("mask_w2", uniform_pm(k_h), (e, h, f))
+        mask_b2 = self.param("mask_b2", uniform_pm(k_h), (e, f))
+
+        hidden_act = nn.relu(mask_w1 + mask_b1)                      # [E, H]
+        logits = jnp.einsum("eh,ehf->ef", hidden_act, mask_w2) + mask_b2
+        mask = jax.nn.softmax(logits, axis=-1)                        # [E, F]
+
+        # (b) bidirectional GRU over the window (reference: qrnn.py:24,39-43).
+        k_g = 1.0 / h ** 0.5
+
+        def gru_params(name):
+            return GRUParams(
+                w_ih=self.param(f"{name}_w_ih", uniform_pm(k_g), (e, f, 3 * h)),
+                w_hh=self.param(f"{name}_w_hh", uniform_pm(k_g), (e, h, 3 * h)),
+                b_ih=self.param(f"{name}_b_ih", uniform_pm(k_g), (e, 3 * h)),
+                b_hh=self.param(f"{name}_b_hh", uniform_pm(k_g), (e, 3 * h)),
+            )
+
+        fwd, bwd = gru_params("gru_fwd"), gru_params("gru_bwd")
+
+        # Fold the mask into the input weights: (x ⊙ m) @ W == x @ (m ⊙ W).
+        def masked(p: GRUParams) -> GRUParams:
+            return p._replace(w_ih=mask[:, :, None] * p.w_ih)
+
+        xc = x.astype(compute_dtype)
+        if cfg.bidirectional:
+            rnn_out = bidirectional_gru(
+                jax.tree.map(lambda a: a.astype(compute_dtype), masked(fwd)),
+                jax.tree.map(lambda a: a.astype(compute_dtype), masked(bwd)),
+                xc,
+            )
+        else:
+            rnn_out = gru(
+                jax.tree.map(lambda a: a.astype(compute_dtype), masked(fwd)), xc
+            )
+        rnn_out = rnn_out.astype(jnp.float32)                         # [E,B,T,D]
+        rnn_out = nn.Dropout(rate=cfg.dropout_rate)(
+            rnn_out, deterministic=deterministic
+        )
+
+        # (c) cross-expert mixing + per-metric quantile heads
+        # (reference: qrnn.py:46-55), via the O(E) sum-minus-own identity.
+        if e > 1:
+            total = jnp.sum(rnn_out, axis=0, keepdims=True)           # [1,B,T,D]
+            mix = (total - rnn_out) / (e - 1)                         # [E,B,T,D]
+        else:
+            mix = rnn_out
+        head_in = jnp.concatenate([mix, rnn_out], axis=-1)            # [E,B,T,2D]
+
+        d_in = head_in.shape[-1]
+        k_d = 1.0 / d_in ** 0.5
+        head_w = self.param("head_w", uniform_pm(k_d), (e, d_in, q))
+        head_b = self.param("head_b", uniform_pm(k_d), (e, q))
+        preds = jnp.einsum("ebtd,edq->ebtq", head_in, head_w)
+        preds = preds + head_b[:, None, None, :]
+        return jnp.transpose(preds, (1, 2, 0, 3))                     # [B,T,E,Q]
+
+    # ------------------------------------------------------------------
+    @property
+    def quantiles(self) -> tuple[float, ...]:
+        return self.config.quantiles
+
+    def median_index(self) -> int:
+        """Index of the .50 quantile in the output's last axis (the point
+        estimate the reference plots/evaluates, estimate.py:103)."""
+        diffs = [abs(qv - 0.5) for qv in self.config.quantiles]
+        return diffs.index(min(diffs))

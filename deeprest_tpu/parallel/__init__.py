@@ -1,0 +1,19 @@
+"""Device-mesh construction and GSPMD sharding rules."""
+
+from deeprest_tpu.parallel.mesh import make_mesh
+from deeprest_tpu.parallel.sharding import (
+    batch_sharding,
+    param_sharding,
+    param_specs,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "param_sharding",
+    "param_specs",
+    "shard_batch",
+    "shard_params",
+]

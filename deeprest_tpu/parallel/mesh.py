@@ -1,0 +1,52 @@
+"""Logical device mesh over TPU ICI.
+
+The reference's ML core is single-device (reference:
+resource-estimation/estimate.py:10 — one cuda/cpu pick, no DDP/NCCL
+anywhere); distribution is *introduced* here the TPU way: one logical mesh
+with three axes, all parallelism expressed as sharding annotations, all
+collectives inserted by the GSPMD partitioner and riding ICI.
+
+Axes (SURVEY.md §2.5):
+- ``data``   — batch dimension (DP; gradient all-reduce over ICI),
+- ``expert`` — the stacked per-metric experts (EP; the only cross-expert
+  dataflow is the mixing sum, one all-reduce over this axis),
+- ``model``  — the call-path feature dimension of the mask/GRU input
+  projections (TP; pressure point when |M| reaches 10k endpoints).
+
+Pipeline and sequence axes are deliberately absent: window length is 60 and
+the recurrent core is the reference's long-context answer (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from deeprest_tpu.config import MeshConfig
+
+AXES = ("data", "expert", "model")
+
+
+def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the (data, expert, model) mesh.
+
+    Defaults to all available devices on the data axis when no config is
+    given; a 1×1×1 config is a valid single-device mesh, so the trainer uses
+    one code path everywhere.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(data=len(devices))
+    if config.size > len(devices):
+        raise ValueError(
+            f"mesh {config.data}x{config.expert}x{config.model} needs "
+            f"{config.size} devices, only {len(devices)} available"
+        )
+    grid = np.asarray(devices[: config.size]).reshape(
+        config.data, config.expert, config.model
+    )
+    return Mesh(grid, AXES)

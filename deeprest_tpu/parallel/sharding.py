@@ -1,0 +1,66 @@
+"""Sharding rules: parameter-name → PartitionSpec.
+
+Every QuantileGRU parameter carries a leading expert axis (models/qrnn.py),
+so EP is uniformly "axis 0 on ``expert``"; TP shards the call-path feature
+dimension F where it appears (the mask output and the GRU input
+projections — the two places that grow with the endpoint vocabulary,
+SURVEY.md §7.3); everything else is replicated.  The batch shards on
+``data``.  No manual collectives anywhere: the cross-expert mixing sum and
+the gradient all-reduce are inserted by GSPMD from these annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# parameter name → spec; F is the TP-sharded feature axis.
+_PARAM_SPECS: dict[str, P] = {
+    "mask_w1": P("expert", None),            # [E, H]
+    "mask_b1": P("expert", None),            # [E, H]
+    "mask_w2": P("expert", None, "model"),   # [E, H, F]
+    "mask_b2": P("expert", "model"),         # [E, F]
+    "gru_fwd_w_ih": P("expert", "model", None),  # [E, F, 3H]
+    "gru_bwd_w_ih": P("expert", "model", None),
+    "gru_fwd_w_hh": P("expert", None, None),     # [E, H, 3H]
+    "gru_bwd_w_hh": P("expert", None, None),
+    "gru_fwd_b_ih": P("expert", None),       # [E, 3H]
+    "gru_bwd_b_ih": P("expert", None),
+    "gru_fwd_b_hh": P("expert", None),
+    "gru_bwd_b_hh": P("expert", None),
+    "head_w": P("expert", None, None),       # [E, 4H, Q]
+    "head_b": P("expert", None),             # [E, Q]
+}
+
+
+def param_specs(params: Mapping[str, Any]) -> dict[str, P]:
+    """PartitionSpec tree mirroring a QuantileGRU param dict."""
+    specs = {}
+    for name in params:
+        if name not in _PARAM_SPECS:
+            raise KeyError(f"no sharding rule for parameter {name!r}")
+        specs[name] = _PARAM_SPECS[name]
+    return specs
+
+
+def param_sharding(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec) for k, spec in param_specs(params).items()}
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
+    """Batch arrays shard on ``data`` along axis 0; rest replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def shard_params(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, jax.Array]:
+    shardings = param_sharding(mesh, params)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def shard_batch(mesh: Mesh, *arrays: jax.Array | Any) -> tuple[jax.Array, ...]:
+    out = tuple(
+        jax.device_put(a, batch_sharding(mesh, getattr(a, "ndim", 1))) for a in arrays
+    )
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,19 @@
+"""Training/eval loops, dataset preparation, checkpointing, metrics."""
+
+from deeprest_tpu.train.data import DatasetBundle, prepare_dataset
+from deeprest_tpu.train.trainer import Trainer, TrainState
+from deeprest_tpu.train.metrics import mae_report, format_report, Throughput
+from deeprest_tpu.train.checkpoint import save_checkpoint, restore_checkpoint, latest_step
+
+__all__ = [
+    "DatasetBundle",
+    "prepare_dataset",
+    "Trainer",
+    "TrainState",
+    "mae_report",
+    "format_report",
+    "Throughput",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+]

@@ -1,0 +1,72 @@
+"""Orbax checkpointing — the capability the reference lacks entirely
+(its model never touches disk; SURVEY.md §5.4).
+
+Checkpoints hold the full train state (params, optimizer state, step, rng)
+plus a JSON sidecar of host-side state that must survive restarts with it:
+normalization statistics, metric names, and the config — so a restored
+trainer predicts identically, not just resumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_SIDECAR = "host_state.json"
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    extra: dict | None = None) -> str:
+    """Write ``directory/step_NNNNNNNN/`` (atomic via orbax) + sidecar."""
+    path = _step_dir(directory, step)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    if extra is not None:
+        with open(os.path.join(path, _SIDECAR), "w", encoding="utf-8") as f:
+            json.dump(extra, f, indent=2, sort_keys=True)
+    return path
+
+
+def latest_step(directory: str) -> int | None:
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(directory)
+        if (m := _STEP_RE.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, target: Any,
+                       step: int | None = None) -> tuple[Any, dict | None]:
+    """Restore the train state (sharded like ``target``) and the sidecar.
+
+    ``target`` is a concrete or abstract state pytree (e.g. a freshly
+    initialized TrainState) defining structure, dtypes, and shardings.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    path = _step_dir(directory, step)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path, abstract)
+    sidecar_path = os.path.join(path, _SIDECAR)
+    extra = None
+    if os.path.exists(sidecar_path):
+        with open(sidecar_path, "r", encoding="utf-8") as f:
+            extra = json.load(f)
+    return state, extra

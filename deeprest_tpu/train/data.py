@@ -1,0 +1,81 @@
+"""Dataset preparation: featurized corpus → normalized train/test windows.
+
+Mirrors the reference driver's data path (reference:
+resource-estimation/estimate.py:26-57): sliding windows over traffic and
+stacked resource series, leading-fraction train split, global min-max on the
+traffic, per-metric min-max on the targets — with the scales kept as
+explicit :class:`MinMaxStats` state instead of loose tuples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from deeprest_tpu.config import TrainConfig
+from deeprest_tpu.data.featurize import FeaturizedData
+from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
+
+
+@dataclasses.dataclass
+class DatasetBundle:
+    """Normalized windows plus everything needed to de-normalize and compare."""
+
+    x_train: np.ndarray        # [N_train, W, F] normalized traffic windows
+    y_train: np.ndarray        # [N_train, W, E] normalized targets
+    x_test: np.ndarray         # [N_test, W, F]
+    y_test: np.ndarray         # [N_test, W, E]
+    x_stats: MinMaxStats
+    y_stats: MinMaxStats       # per-metric (broadcast shape [1, E])
+    metric_names: list[str]
+    split: int                 # number of train windows
+    window_size: int
+
+    @property
+    def num_metrics(self) -> int:
+        return len(self.metric_names)
+
+    @property
+    def feature_dim(self) -> int:
+        return self.x_train.shape[-1]
+
+    def denorm_targets(self, y: np.ndarray) -> np.ndarray:
+        return self.y_stats.invert(y)
+
+
+def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
+    """Window, split, and normalize a featurized corpus."""
+    w = config.window_size
+    x = sliding_windows(data.traffic, w)          # [N, W, F]
+    y = sliding_windows(data.targets(), w)        # [N, W, E]
+    split = int(len(x) * config.train_split)
+    if split < 1 or split >= len(x):
+        raise ValueError(
+            f"train_split={config.train_split} gives {split} train windows "
+            f"of {len(x)} total; corpus too short for window_size={w}"
+        )
+
+    x_stats = minmax_fit(x, split)                    # global, traffic
+    y_stats = minmax_fit(y, split, axis=(0, 1))       # per metric
+    x_n = x_stats.apply(x).astype(np.float32)
+    y_n = y_stats.apply(y).astype(np.float32)
+
+    return DatasetBundle(
+        x_train=x_n[:split],
+        y_train=y_n[:split],
+        x_test=x_n[split:],
+        y_test=y_n[split:],
+        x_stats=x_stats,
+        y_stats=y_stats,
+        metric_names=list(data.metric_names),
+        split=split,
+        window_size=w,
+    )
+
+
+def eval_window_indices(num_test: int, stride: int, max_cycles: int) -> np.ndarray:
+    """Non-overlapping test windows: every ``stride``-th, capped at
+    ``max_cycles`` (reference: resource-estimation/estimate.py:85-88)."""
+    idx = np.arange(0, num_test, stride)
+    return idx[:max_cycles]

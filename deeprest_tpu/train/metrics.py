@@ -1,0 +1,81 @@
+"""Evaluation metrics and throughput accounting.
+
+The MAE percentile report matches the reference's console evaluation
+line-for-line (reference: resource-estimation/estimate.py:100-123): absolute
+errors of the de-normalized median-quantile prediction, pooled over all
+evaluated windows, reported at median/95th/99th/max per metric and method.
+Steps/sec accounting is the capability the reference lacks entirely
+(SURVEY.md §5.1) and the headline benchmark metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Mapping
+
+import numpy as np
+
+
+def mae_report(
+    errors_by_method: Mapping[str, np.ndarray],
+    metric_names: list[str],
+) -> dict[str, dict[str, dict[str, float]]]:
+    """Pooled absolute errors → per-metric percentile table.
+
+    Args:
+      errors_by_method: method name → ``[num_windows, W, E]`` absolute errors.
+      metric_names: length-E metric labels.
+
+    Returns: ``{metric: {method: {median, p95, p99, max}}}``.
+    """
+    report: dict[str, dict[str, dict[str, float]]] = {}
+    for idx, name in enumerate(metric_names):
+        report[name] = {}
+        for method, errs in errors_by_method.items():
+            pooled = np.asarray(errs)[:, :, idx].ravel()
+            report[name][method] = {
+                "median": float(np.median(pooled)),
+                "p95": float(np.percentile(pooled, 95)),
+                "p99": float(np.percentile(pooled, 99)),
+                "max": float(np.max(pooled)),
+            }
+    return report
+
+
+def format_report(report: Mapping[str, Mapping[str, Mapping[str, float]]]) -> str:
+    """Render the reference-style eval block (estimate.py:112-122)."""
+    lines = []
+    for metric, methods in report.items():
+        lines.append(f"===== {metric} =====")
+        for method, stats in methods.items():
+            lines.append(
+                f"   {method.upper():6s}=> Median: {stats['median']:.4f} | "
+                f"95-th: {stats['p95']:.4f} | 99-th: {stats['p99']:.4f} | "
+                f"Max: {stats['max']:.4f}"
+            )
+    return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class Throughput:
+    """Steps/sec meter; ``jax.block_until_ready`` at the measurement edges
+    is the caller's responsibility."""
+
+    steps: int = 0
+    _t0: float | None = None
+    elapsed: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, steps: int) -> None:
+        if self._t0 is None:
+            raise RuntimeError("Throughput.stop() without start()")
+        self.elapsed += time.perf_counter() - self._t0
+        self.steps += steps
+        self._t0 = None
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self.steps / self.elapsed if self.elapsed > 0 else 0.0

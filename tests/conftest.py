@@ -7,10 +7,17 @@ absent distributed tests).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, not setdefault: the shell carries JAX_PLATFORMS=axon (the real
+# TPU) and the axon site hook re-exports it, so the env var alone is not
+# enough — force the platform through jax.config before any backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
