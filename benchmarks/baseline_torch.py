@@ -1,0 +1,98 @@
+"""Reference-equivalent PyTorch model, used only to anchor the benchmark
+ratio.
+
+The reference publishes accuracy numbers but no throughput (BASELINE.md), so
+the torch single-device steps/sec must be measured locally to anchor
+``vs_baseline``.  This module implements the same architecture the reference
+describes (per-metric experts: constant-driven mask MLP + softmax,
+bidirectional GRU, cross-expert-mean quantile heads; reference:
+resource-estimation/qrnn.py:6-67) using public torch APIs, in the same
+one-module-per-expert, Python-loop style as the reference — because that
+style *is* the baseline being compared against.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import torch
+from torch import nn
+
+
+class _Expert(nn.Module):
+    def __init__(self, num_features: int, hidden: int, num_quantiles: int):
+        super().__init__()
+        self.mask_in = nn.Linear(1, hidden)
+        self.mask_out = nn.Linear(hidden, num_features)
+        self.rnn = nn.GRU(num_features, hidden, bidirectional=True)
+        self.head = nn.Linear(4 * hidden, num_quantiles)
+
+    def mask(self) -> torch.Tensor:
+        one = torch.ones(1, device=self.mask_in.weight.device)
+        return torch.softmax(self.mask_out(torch.relu(self.mask_in(one))), dim=-1)
+
+
+class TorchQuantileRNN(nn.Module):
+    """Multi-task quantile GRU in the reference's per-expert-loop style."""
+
+    def __init__(self, num_features: int, num_metrics: int, hidden: int = 128,
+                 quantiles: tuple[float, ...] = (0.05, 0.50, 0.95),
+                 dropout: float = 0.5):
+        super().__init__()
+        self.quantiles = quantiles
+        self.drop = nn.Dropout(dropout)
+        self.experts = nn.ModuleList(
+            _Expert(num_features, hidden, len(quantiles)) for _ in range(num_metrics)
+        )
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:  # x: [B, T, F]
+        states = []
+        for expert in self.experts:
+            seq = (x * expert.mask()).permute(1, 0, 2)       # [T, B, F]
+            out, _ = expert.rnn(seq)
+            states.append(self.drop(out.permute(1, 0, 2)))    # [B, T, 2H]
+
+        preds = []
+        n = len(states)
+        for i, expert in enumerate(self.experts):
+            others = torch.stack([states[j] for j in range(n) if j != i])
+            mixed = torch.cat([others.mean(dim=0), states[i]], dim=-1)
+            preds.append(expert.head(mixed))
+        return torch.stack(preds, dim=2)                      # [B, T, E, Q]
+
+    def loss(self, preds: torch.Tensor, targets: torch.Tensor) -> torch.Tensor:
+        q = torch.tensor(self.quantiles, device=preds.device)
+        err = targets.unsqueeze(-1) - preds
+        pin = torch.maximum((q - 1.0) * err, q * err)
+        return pin.sum(dim=-1).mean(dim=(0, 1)).mean()
+
+
+def measure_steps_per_sec(
+    batch: int, window: int, num_features: int, num_metrics: int,
+    hidden: int = 128, steps: int = 4, warmup: int = 1, device: str = "cpu",
+    seed: int = 0,
+) -> float:
+    """Adam train-step throughput of the torch model on ``device``."""
+    torch.manual_seed(seed)
+    dev = torch.device(device)
+    model = TorchQuantileRNN(num_features, num_metrics, hidden).to(dev)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    rng = np.random.default_rng(seed)
+    x = torch.from_numpy(rng.random((batch, window, num_features), np.float32)).to(dev)
+    y = torch.from_numpy(rng.random((batch, window, num_metrics), np.float32)).to(dev)
+
+    def step():
+        opt.zero_grad()
+        loss = model.loss(model(x), y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    if dev.type == "cuda":
+        torch.cuda.synchronize()
+    return steps / (time.perf_counter() - t0)

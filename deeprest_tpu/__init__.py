@@ -19,9 +19,6 @@ Package layout
                 metrics (MAE percentile reports, steps/sec).
 - ``parallel``  device-mesh construction and sharding rules (data / expert /
                 feature-model axes) for pjit/GSPMD execution over ICI.
-- ``workload``  the capability harness: scenario-driven workload/telemetry
-                simulator producing training corpora at DeathStarBench scale.
-- ``serve``     trained-model export and what-if serving.
 """
 
 __version__ = "0.1.0"

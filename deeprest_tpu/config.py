@@ -75,6 +75,13 @@ class FeaturizeConfig:
     hash_features: bool = False
     hash_seed: int = 0x5EED
 
+    def __post_init__(self):
+        if self.hash_features and self.capacity <= 0:
+            raise ValueError(
+                "hash_features=True requires an explicit capacity > 0 "
+                "(there is no observed vocabulary to size the space from)"
+            )
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:

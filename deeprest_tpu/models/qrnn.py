@@ -74,35 +74,43 @@ class QuantileGRU(nn.Module):
         logits = jnp.einsum("eh,ehf->ef", hidden_act, mask_w2) + mask_b2
         mask = jax.nn.softmax(logits, axis=-1)                        # [E, F]
 
-        # (b) bidirectional GRU over the window (reference: qrnn.py:24,39-43).
+        # (b) (stacked) bidirectional GRU over the window (reference:
+        # qrnn.py:24,39-43; layer l>0 consumes layer l-1's output, matching
+        # torch's stacked-GRU semantics with zero inter-layer dropout).
         k_g = 1.0 / h ** 0.5
 
-        def gru_params(name):
+        def gru_params(name, in_dim):
             return GRUParams(
-                w_ih=self.param(f"{name}_w_ih", uniform_pm(k_g), (e, f, 3 * h)),
+                w_ih=self.param(f"{name}_w_ih", uniform_pm(k_g), (e, in_dim, 3 * h)),
                 w_hh=self.param(f"{name}_w_hh", uniform_pm(k_g), (e, h, 3 * h)),
                 b_ih=self.param(f"{name}_b_ih", uniform_pm(k_g), (e, 3 * h)),
                 b_hh=self.param(f"{name}_b_hh", uniform_pm(k_g), (e, 3 * h)),
             )
 
-        fwd, bwd = gru_params("gru_fwd"), gru_params("gru_bwd")
-
         # Fold the mask into the input weights: (x ⊙ m) @ W == x @ (m ⊙ W).
         def masked(p: GRUParams) -> GRUParams:
             return p._replace(w_ih=mask[:, :, None] * p.w_ih)
 
-        xc = x.astype(compute_dtype)
-        if cfg.bidirectional:
-            rnn_out = bidirectional_gru(
-                jax.tree.map(lambda a: a.astype(compute_dtype), masked(fwd)),
-                jax.tree.map(lambda a: a.astype(compute_dtype), masked(bwd)),
-                xc,
-            )
-        else:
-            rnn_out = gru(
-                jax.tree.map(lambda a: a.astype(compute_dtype), masked(fwd)), xc
-            )
-        rnn_out = rnn_out.astype(jnp.float32)                         # [E,B,T,D]
+        def cast(p: GRUParams) -> GRUParams:
+            return jax.tree.map(lambda a: a.astype(compute_dtype), p)
+
+        out = x.astype(compute_dtype)                                  # [B,T,F]
+        for layer in range(cfg.num_layers):
+            sfx = "" if layer == 0 else f"_l{layer}"
+            in_dim = f if layer == 0 else cfg.rnn_out_dim
+            fwd = gru_params(f"gru_fwd{sfx}", in_dim)
+            if layer == 0:
+                fwd = masked(fwd)
+            if cfg.bidirectional:
+                bwd = gru_params(f"gru_bwd{sfx}", in_dim)
+                if layer == 0:
+                    bwd = masked(bwd)
+                out = bidirectional_gru(cast(fwd), cast(bwd), out)
+            else:
+                out = gru(cast(fwd), out)
+            # layer 0 broadcasts [B,T,F] across experts; the output (and all
+            # deeper layers) carry the expert axis: [E,B,T,D].
+        rnn_out = out.astype(jnp.float32)
         rnn_out = nn.Dropout(rate=cfg.dropout_rate)(
             rnn_out, deterministic=deterministic
         )

@@ -11,6 +11,7 @@ the gradient all-reduce are inserted by GSPMD from these annotations.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Mapping
 
 import jax
@@ -35,13 +36,27 @@ _PARAM_SPECS: dict[str, P] = {
 }
 
 
+_LAYER_SUFFIX = re.compile(r"_l\d+(_)")
+
+
+def _rule_key(name: str) -> str:
+    """Canonical rule name: stacked-layer params (gru_fwd_l1_w_ih) share the
+    base rule, except deep-layer w_ih whose input dim is hidden-sized (2H),
+    not the TP-sharded feature axis — those replicate like w_hh."""
+    base = _LAYER_SUFFIX.sub(r"\1", name)
+    if base != name and base.endswith("_w_ih"):
+        return base.replace("_w_ih", "_w_hh")
+    return base
+
+
 def param_specs(params: Mapping[str, Any]) -> dict[str, P]:
     """PartitionSpec tree mirroring a QuantileGRU param dict."""
     specs = {}
     for name in params:
-        if name not in _PARAM_SPECS:
+        key = _rule_key(name)
+        if key not in _PARAM_SPECS:
             raise KeyError(f"no sharding rule for parameter {name!r}")
-        specs[name] = _PARAM_SPECS[name]
+        specs[name] = _PARAM_SPECS[key]
     return specs
 
 

@@ -65,6 +65,8 @@ class Trainer:
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         self._batch_shd = batch_sharding(self.mesh)
         self.throughput = Throughput()
+        self._warmed = False       # first-ever step (jit compile) excluded
+        self._global_step = 0      # host-side mirror of state.step for logging
 
         quantiles = self.model_config.quantiles
 
@@ -134,18 +136,33 @@ class Trainer:
 
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
                     epoch_rng: np.random.Generator) -> tuple[TrainState, float]:
+        log_every = self.config.train.log_every_steps
         losses = []
-        self.throughput.start()
         steps = 0
+        measuring = self._warmed
+        if measuring:
+            self.throughput.start()
         for sel, weight in self._batches(len(bundle.x_train), epoch_rng):
             xb = jax.device_put(bundle.x_train[sel], self._batch_shd)
             yb = jax.device_put(bundle.y_train[sel], self._batch_shd)
             wb = jax.device_put(weight, batch_sharding(self.mesh, 1))
             state, loss = self._train_step(state, xb, yb, wb)
             losses.append(loss)
-            steps += 1
+            self._global_step += 1
+            if not self._warmed:
+                # The first step ever pays jit trace+compile; keep it out of
+                # the throughput window so steps/sec reflects steady state.
+                jax.block_until_ready(loss)
+                self._warmed = True
+                self.throughput.start()
+                measuring = True
+            else:
+                steps += 1
+            if log_every and self._global_step % log_every == 0:
+                print(f"step {self._global_step}: loss {float(loss):.6f}")
         jax.block_until_ready(state.params)
-        self.throughput.stop(steps)
+        if measuring:
+            self.throughput.stop(steps)
         return state, float(np.mean([float(l) for l in losses]))
 
     # ------------------------------------------------------------------
@@ -202,7 +219,8 @@ class Trainer:
             state = self.init_state(bundle.x_train)
         data_rng = np.random.default_rng(cfg.seed)
         history: list[EpochResult] = []
-        for epoch in range(num_epochs if num_epochs is not None else cfg.num_epochs):
+        total = num_epochs if num_epochs is not None else cfg.num_epochs
+        for epoch in range(total):
             state, train_loss = self.train_epoch(state, bundle, data_rng)
             test_loss, report = self.evaluate(state, bundle, baseline_preds)
             result = EpochResult(epoch=epoch, train_loss=train_loss,
@@ -210,7 +228,25 @@ class Trainer:
             history.append(result)
             if on_epoch is not None:
                 on_epoch(result, state)
+            if cfg.checkpoint_dir and (
+                (epoch + 1) % cfg.checkpoint_every_epochs == 0
+                or epoch + 1 == total
+            ):
+                self.save(cfg.checkpoint_dir, state, bundle)
         return state, history
+
+    def save(self, directory: str, state: TrainState, bundle: DatasetBundle) -> str:
+        """Checkpoint the state plus the host-side stats needed to serve."""
+        from deeprest_tpu.train.checkpoint import save_checkpoint
+
+        extra = {
+            "metric_names": bundle.metric_names,
+            "x_stats": bundle.x_stats.to_dict(),
+            "y_stats": bundle.y_stats.to_dict(),
+            "window_size": bundle.window_size,
+            "feature_dim": bundle.feature_dim,
+        }
+        return save_checkpoint(directory, state, int(state.step), extra)
 
     # ------------------------------------------------------------------
 

@@ -123,6 +123,28 @@ def test_feature_dim_mismatch_raises():
         assert "feature_dim" in str(e)
 
 
+def test_stacked_layers():
+    cfg = ModelConfig(feature_dim=6, num_metrics=2, hidden_size=4, num_layers=2)
+    model, variables, x = init_model(cfg)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 5, 2, 3)
+    p = variables["params"]
+    assert "gru_fwd_l1_w_ih" in p and "gru_bwd_l1_w_ih" in p
+    # deep-layer input dim is the previous layer's output (2H bidirectional)
+    assert p["gru_fwd_l1_w_ih"].shape == (2, 8, 12)
+    # all stacked params have sharding rules
+    from deeprest_tpu.parallel import param_specs
+    specs = param_specs(p)
+    assert set(specs) == set(p)
+
+    @jax.jit
+    def loss_fn(params):
+        return jnp.mean(model.apply({"params": params}, x) ** 2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    assert np.abs(np.asarray(g["gru_fwd_l1_w_ih"])).max() > 0
+
+
 def test_bfloat16_compute_path():
     cfg = ModelConfig(feature_dim=6, num_metrics=2, hidden_size=4,
                       compute_dtype="bfloat16")

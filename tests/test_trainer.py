@@ -128,3 +128,37 @@ def test_predict_shapes(bundle):
     state = trainer.init_state(bundle.x_train)
     preds = trainer.predict(state, bundle.x_test[:7], batch_size=3)
     assert preds.shape == (7, 12, bundle.num_metrics, 3)
+
+
+def test_hash_mode_requires_capacity():
+    import pytest
+    from deeprest_tpu.config import FeaturizeConfig
+    with pytest.raises(ValueError, match="capacity"):
+        FeaturizeConfig(hash_features=True)
+    FeaturizeConfig(hash_features=True, capacity=256)  # fine
+
+
+def test_checkpoint_knobs_wired(bundle, tmp_path):
+    import dataclasses
+    cfg = dataclasses.replace(SMALL, train=dataclasses.replace(
+        SMALL.train, checkpoint_dir=str(tmp_path), checkpoint_every_epochs=2))
+    trainer = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    state, _ = trainer.fit(bundle, num_epochs=3)
+    # epochs 2 and 3 (final) checkpointed
+    steps = sorted(int(p.name.split("_")[1]) for p in tmp_path.iterdir()
+                   if p.name.startswith("step_"))
+    assert len(steps) == 2 and steps[-1] == int(state.step)
+    _, extra = restore_checkpoint(str(tmp_path), trainer.init_state(bundle.x_train))
+    assert extra["metric_names"] == bundle.metric_names
+    assert extra["feature_dim"] == bundle.feature_dim
+
+
+def test_throughput_excludes_compile(bundle):
+    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    state = trainer.init_state(bundle.x_train)
+    n_batches = -(-len(bundle.x_train) // SMALL.train.batch_size)
+    state, _ = trainer.train_epoch(state, bundle, np.random.default_rng(0))
+    # first-ever step (compile) excluded from the measured window
+    assert trainer.throughput.steps == n_batches - 1
+    state, _ = trainer.train_epoch(state, bundle, np.random.default_rng(1))
+    assert trainer.throughput.steps == 2 * n_batches - 1
