@@ -19,6 +19,10 @@ Package layout
                 metrics (MAE percentile reports, steps/sec).
 - ``parallel``  device-mesh construction and sharding rules (data / expert /
                 feature-model axes) for pjit/GSPMD execution over ICI.
+- ``workload``  the capability harness: scenario-driven workload/telemetry
+                simulator producing training corpora at DeathStarBench scale.
+- ``serve``     checkpoint-backed prediction, what-if capacity estimation,
+                and traffic-conditioned anomaly detection.
 """
 
 __version__ = "0.1.0"

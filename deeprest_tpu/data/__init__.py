@@ -13,6 +13,7 @@ from deeprest_tpu.data.windows import (
     minmax_apply,
     minmax_invert,
 )
+from deeprest_tpu.data.synthesize import TraceSynthesizer
 
 __all__ = [
     "Span",
@@ -27,4 +28,5 @@ __all__ = [
     "minmax_fit",
     "minmax_apply",
     "minmax_invert",
+    "TraceSynthesizer",
 ]

@@ -204,7 +204,14 @@ def featurize_buckets(
     """Full-corpus featurization: traffic, resources, invocation counts."""
     config = config or FeaturizeConfig()
     if space is None:
-        space = CallPathSpace.fit(buckets, config)
+        space = CallPathSpace(config=config)
+    # Observe before extracting (no-op in hash mode): a caller-provided
+    # fresh space would otherwise freeze at minimum capacity and silently
+    # drop every path.  Already-frozen spaces are left untouched — novel
+    # eval-corpus paths could never be addressed anyway, and growing the
+    # index across serve-time calls would leak memory.
+    if space.frozen_capacity is None:
+        space.observe(buckets)
 
     traffic = space.extract_buckets(buckets)
 

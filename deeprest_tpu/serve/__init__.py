@@ -1,0 +1,7 @@
+"""Serving: trained-model prediction, what-if estimation, anomaly detection."""
+
+from deeprest_tpu.serve.predictor import Predictor
+from deeprest_tpu.serve.whatif import WhatIfEstimator
+from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
+
+__all__ = ["Predictor", "WhatIfEstimator", "AnomalyDetector", "AnomalyReport"]

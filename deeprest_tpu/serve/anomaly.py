@@ -1,0 +1,81 @@
+"""Application sanity checking: utilization not justified by traffic.
+
+The second DeepRest use case (reference: README.md:5): compare *observed*
+per-component utilization against the model's traffic-conditioned
+prediction interval; sustained usage above the upper quantile means some
+consumer other than the API traffic is at work (cryptojacking CPU burners,
+ransomware-style IO).  The reference demonstrates this experimentally
+(crypto locust scenario + pow.py) but ships no detector; this module is
+that missing piece."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from deeprest_tpu.serve.predictor import Predictor
+
+
+@dataclasses.dataclass
+class AnomalyReport:
+    metric: str
+    score: float               # mean normalized excess above the upper band
+    flagged: bool
+    first_flag_index: int | None   # start of the first sustained excess run
+    excess: np.ndarray         # [T] per-step normalized excess
+
+    def __repr__(self) -> str:  # compact, log-friendly
+        state = "ANOMALOUS" if self.flagged else "ok"
+        return (f"AnomalyReport({self.metric}: {state}, score={self.score:.4f}, "
+                f"first_flag={self.first_flag_index})")
+
+
+class AnomalyDetector:
+    """Flags sustained utilization above the traffic-justified upper band."""
+
+    def __init__(self, predictor: Predictor, tolerance: float = 0.10,
+                 min_run: int = 5,
+                 reanchor_resources: tuple[str, ...] = ("usage", "memory")):
+        """tolerance: fractional headroom over the upper quantile before a
+        step counts as excess; min_run: consecutive excess steps required to
+        flag (rules out single-scrape spikes); reanchor_resources: level-type
+        resources whose absolute value depends on history the traffic can't
+        see (cumulative disk usage, resident memory) — their prediction bands
+        are shifted to start at the first observed value, the reference
+        demo's re-anchoring trick (web-demo/dataloader.py:143-156)."""
+        self.predictor = predictor
+        self.tolerance = tolerance
+        self.min_run = min_run
+        self.reanchor_resources = reanchor_resources
+
+    def check(self, traffic: np.ndarray, observed: np.ndarray) -> list[AnomalyReport]:
+        """traffic: [T, F] feature series; observed: [T, E] de-normalized
+        utilization aligned with ``predictor.metric_names``."""
+        preds = self.predictor.predict_series(traffic)      # [T, E, Q]
+        med = self.predictor.model.median_index()
+        for e, metric in enumerate(self.predictor.metric_names):
+            resource = metric.rsplit("_", 1)[-1]
+            if resource in self.reanchor_resources:
+                preds[:, e, :] += observed[0, e] - preds[0, e, med]
+        upper = preds[..., -1]                               # highest quantile
+        scale = np.maximum(np.abs(upper), 1e-6)
+        excess = np.maximum(observed - upper * (1 + self.tolerance), 0.0) / scale
+
+        reports = []
+        for e, metric in enumerate(self.predictor.metric_names):
+            ex = excess[:, e]
+            run, first, longest = 0, None, 0
+            for t, v in enumerate(ex):
+                run = run + 1 if v > 0 else 0
+                longest = max(longest, run)
+                if run >= self.min_run and first is None:
+                    first = t - self.min_run + 1
+            reports.append(AnomalyReport(
+                metric=metric,
+                score=float(ex.mean()),
+                flagged=longest >= self.min_run,
+                first_flag_index=first,
+                excess=ex,
+            ))
+        return reports

@@ -1,0 +1,101 @@
+"""The inference engine: checkpointed model → utilization predictions.
+
+Bundles everything a consumer needs — params, model config, normalization
+statistics, metric names — restored from one checkpoint directory, so
+serving cannot drift from training state (the reference never serializes
+its model at all; SURVEY.md §5.4).  Prediction over arbitrary-length
+traffic series runs the window as a rolling jit-compiled batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeprest_tpu.config import Config, ModelConfig
+from deeprest_tpu.data.windows import MinMaxStats
+from deeprest_tpu.models.qrnn import QuantileGRU
+
+
+class Predictor:
+    """Quantile predictions for traffic feature series."""
+
+    def __init__(self, params, model_config: ModelConfig,
+                 x_stats: MinMaxStats, y_stats: MinMaxStats,
+                 metric_names: list[str], window_size: int):
+        self.params = params
+        self.model = QuantileGRU(config=model_config)
+        self.x_stats = x_stats
+        self.y_stats = y_stats
+        self.metric_names = list(metric_names)
+        self.window_size = window_size
+        self._apply = jax.jit(
+            lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
+        )
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, directory: str, config: Config,
+                        step: int | None = None) -> "Predictor":
+        """Restore params + host stats written by Trainer.save()."""
+        from deeprest_tpu.train.checkpoint import restore_checkpoint
+        from deeprest_tpu.train.trainer import Trainer
+
+        import json
+        import os
+
+        from deeprest_tpu.train.checkpoint import latest_step, _step_dir, _SIDECAR
+
+        if step is None:
+            step = latest_step(directory)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {directory!r}")
+        with open(os.path.join(_step_dir(directory, step), _SIDECAR),
+                  encoding="utf-8") as f:
+            extra = json.load(f)
+
+        metric_names = extra["metric_names"]
+        trainer = Trainer(config, extra["feature_dim"], metric_names)
+        target = trainer.init_state(
+            np.zeros((1, extra["window_size"], extra["feature_dim"]), np.float32)
+        )
+        state, _ = restore_checkpoint(directory, target, step=step)
+        return cls(
+            params=state.params,
+            model_config=trainer.model_config,
+            x_stats=MinMaxStats.from_dict(extra["x_stats"]),
+            y_stats=MinMaxStats.from_dict(extra["y_stats"]),
+            metric_names=metric_names,
+            window_size=extra["window_size"],
+        )
+
+    # ------------------------------------------------------------------
+
+    def predict_series(self, traffic: np.ndarray) -> np.ndarray:
+        """[T, F] raw traffic features → de-normalized [T, E, Q] predictions.
+
+        The series is tiled into non-overlapping windows (last window
+        right-aligned so every step is covered exactly once; the recurrent
+        core supports any duration — reference claim at
+        resource-estimation/README.md:83).
+        """
+        w = self.window_size
+        t = len(traffic)
+        if t < w:
+            raise ValueError(f"series length {t} < window_size {w}")
+        starts = list(range(0, t - w + 1, w))
+        if starts[-1] != t - w:
+            starts.append(t - w)
+        x = np.stack([traffic[s:s + w] for s in starts]).astype(np.float32)
+        x = self.x_stats.apply(x).astype(np.float32)
+        preds = np.asarray(self._apply(self.params, jnp.asarray(x)))
+        preds = self.y_stats.invert(
+            np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
+        ).transpose(0, 1, 3, 2)
+
+        out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
+        for s, window in zip(starts, preds):
+            out[s:s + w] = window          # later (right-aligned) window wins
+        return out

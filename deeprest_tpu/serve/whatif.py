@@ -1,0 +1,71 @@
+"""What-if capacity estimation: hypothetical API traffic → utilization.
+
+The headline DeepRest use case (reference: README.md:5, web-demo/): "how
+much resource would each component need if traffic looked like X?" for X
+with shapes/scales/compositions never observed.  Pipeline: per-endpoint
+trace synthesis (data/synthesize.py) → feature series → quantile
+predictions per component×resource.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeprest_tpu.data.synthesize import TraceSynthesizer
+from deeprest_tpu.serve.predictor import Predictor
+
+
+class WhatIfEstimator:
+    """Synthesizer + predictor, composed."""
+
+    def __init__(self, predictor: Predictor, synthesizer: TraceSynthesizer):
+        if synthesizer.space.capacity != predictor.model.config.feature_dim:
+            raise ValueError(
+                f"synthesizer capacity {synthesizer.space.capacity} != model "
+                f"feature_dim {predictor.model.config.feature_dim}"
+            )
+        self.predictor = predictor
+        self.synthesizer = synthesizer
+
+    @property
+    def endpoints(self) -> list[str]:
+        return self.synthesizer.endpoints
+
+    def estimate(
+        self,
+        expected_traffic: list[dict[str, int]],
+        seed: int = 0,
+    ) -> dict[str, dict[str, np.ndarray]]:
+        """``expected_traffic[t] = {endpoint: count}`` → per-metric series.
+
+        Returns ``{metric: {"q05"|"q50"|"q95": [T] utilization}}`` (keys
+        follow the configured quantiles).
+        """
+        x = self.synthesizer.synthesize_series(expected_traffic, seed=seed)
+        preds = self.predictor.predict_series(x)          # [T, E, Q]
+        quantiles = self.predictor.model.config.quantiles
+        out: dict[str, dict[str, np.ndarray]] = {}
+        for e, metric in enumerate(self.predictor.metric_names):
+            out[metric] = {
+                f"q{int(q * 100):02d}": preds[:, e, qi]
+                for qi, q in enumerate(quantiles)
+            }
+        return out
+
+    def scaling_factor(
+        self,
+        baseline_traffic: list[dict[str, int]],
+        hypothetical_traffic: list[dict[str, int]],
+        seed: int = 0,
+    ) -> dict[str, float]:
+        """Per-metric peak scaling factor between two traffic programs
+        (the number the reference demo renders as bar charts,
+        web-demo/dataloader.py:143-156)."""
+        base = self.estimate(baseline_traffic, seed=seed)
+        hypo = self.estimate(hypothetical_traffic, seed=seed + 1)
+        factors = {}
+        for metric in base:
+            b = float(np.max(base[metric]["q50"]))
+            h = float(np.max(hypo[metric]["q50"]))
+            factors[metric] = h / b if b > 0 else float("inf")
+        return factors

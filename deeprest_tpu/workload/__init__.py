@@ -1,0 +1,41 @@
+"""The capability harness: a scenario-driven workload + telemetry simulator.
+
+The reference obtains training corpora by deploying a 12-service social
+network on Kubernetes, driving it with locust, and scraping Jaeger +
+Prometheus (reference: social-network/, locust/, minikube-openebs/ —
+SURVEY.md L0-L3).  None of that infrastructure can exist inside a TPU
+training job, but the *capability* it provides — realistic span trees and
+traffic-correlated per-component resource series, under controllable load
+scenarios including anomalies — is reproduced here as a deterministic,
+seedable simulator emitting the exact raw-data contract the data plane
+consumes.  (A native C++ fast path for month-scale corpora is planned under
+native/ — see the roadmap in README.md.)
+"""
+
+from deeprest_tpu.workload.topology import SocialNetworkApp, API_ENDPOINTS
+from deeprest_tpu.workload.scenarios import (
+    LoadScenario,
+    normal_scenario,
+    shape_scenario,
+    scale_scenario,
+    composition_scenario,
+    crypto_scenario,
+    SCENARIOS,
+)
+from deeprest_tpu.workload.telemetry import ResourceModel, Anomaly
+from deeprest_tpu.workload.simulator import simulate_corpus
+
+__all__ = [
+    "SocialNetworkApp",
+    "API_ENDPOINTS",
+    "LoadScenario",
+    "normal_scenario",
+    "shape_scenario",
+    "scale_scenario",
+    "composition_scenario",
+    "crypto_scenario",
+    "SCENARIOS",
+    "ResourceModel",
+    "Anomaly",
+    "simulate_corpus",
+]

@@ -1,0 +1,162 @@
+"""Telemetry plane: per-component resource series from simulated traffic.
+
+Models the five resources the reference predicts (cpu millicores, memory
+WSS MB, write-IOps, write throughput KB, disk usage MB — reference:
+resource-estimation/utils.py:8-26) as functions of per-bucket invocation
+activity: CPU tracks ops with saturation and noise, memory is a
+working-set EMA over recent activity, write metrics track mutation ops on
+stateful components, and disk usage accumulates.  Anomaly injectors
+reproduce the sanity-check experiments: cryptojacking burns CPU decoupled
+from traffic (reference: locust/pow.py), ransomware-style encryption shows
+up as traffic-independent read+rewrite IO (claimed in reference
+README.md:5; no injector ships there — SURVEY.md §5.3)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from deeprest_tpu.data.schema import MetricSample, Span
+
+WRITE_OPS = ("/insert", "/update", "/zadd", "/hset", "/save")
+STATEFUL_SUFFIXES = ("-mongodb", "-redis", "-memcached")
+
+
+def is_stateful(component: str) -> bool:
+    return component.endswith(STATEFUL_SUFFIXES)
+
+
+def count_ops(traces: list[Span]) -> tuple[dict[str, int], dict[str, int]]:
+    """Per-component (all ops, write ops) counts in one bucket."""
+    ops: dict[str, int] = {}
+    writes: dict[str, int] = {}
+    for trace in traces:
+        for _, node in trace.walk():
+            ops[node.component] = ops.get(node.component, 0) + 1
+            if node.operation in WRITE_OPS:
+                writes[node.component] = writes.get(node.component, 0) + 1
+    return ops, writes
+
+
+ANOMALY_KINDS = ("cryptojacking", "ransomware")
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """A traffic-decoupled resource consumer injected into one component."""
+
+    kind: str                  # "cryptojacking" | "ransomware"
+    component: str
+    start: int                 # bucket index, inclusive
+    end: int                   # exclusive
+    magnitude: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in ANOMALY_KINDS:
+            raise ValueError(
+                f"unknown anomaly kind {self.kind!r}; choose from {ANOMALY_KINDS}"
+            )
+
+    def active(self, t: int) -> bool:
+        return self.start <= t < self.end
+
+
+@dataclasses.dataclass
+class ComponentProfile:
+    cpu_per_op: float
+    base_cpu: float
+    base_mem: float
+    mem_per_activity: float
+    kb_per_write: float
+
+
+class ResourceModel:
+    """Stateful telemetry generator; one ``step`` per time bucket."""
+
+    def __init__(self, seed: int = 0, anomalies: list[Anomaly] | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.anomalies = anomalies or []
+        self._profiles: dict[str, ComponentProfile] = {}
+        self._ema: dict[str, float] = {}
+        self._usage: dict[str, float] = {}
+        self._t = 0
+
+    def _profile(self, component: str) -> ComponentProfile:
+        if component not in self._profiles:
+            # Reproducible per-component character, from a stable hash so
+            # profiles depend on neither discovery order nor PYTHONHASHSEED
+            # (process-randomized hash() would break corpus reproducibility
+            # across CLI invocations).
+            import hashlib
+
+            digest = hashlib.blake2b(component.encode(), digest_size=4).digest()
+            r = np.random.default_rng(int.from_bytes(digest, "little"))
+            heavy = 2.0 if component in ("nginx-thrift", "compose-post-service") else 1.0
+            self._profiles[component] = ComponentProfile(
+                cpu_per_op=heavy * r.uniform(0.15, 0.6),
+                base_cpu=r.uniform(2.0, 12.0),
+                base_mem=r.uniform(60.0, 400.0),
+                mem_per_activity=r.uniform(0.02, 0.10),
+                kb_per_write=r.uniform(1.0, 16.0),
+            )
+        return self._profiles[component]
+
+    def step(self, traces: list[Span],
+             components: list[str] | None = None) -> list[MetricSample]:
+        """One bucket of telemetry from raw traces (convenience wrapper)."""
+        ops, writes = count_ops(traces)
+        return self.step_counts(ops, writes, components)
+
+    def step_counts(self, ops: dict[str, int], writes: dict[str, int],
+                    components: list[str] | None = None) -> list[MetricSample]:
+        """One bucket of telemetry from precomputed per-component counts.
+
+        Pass ``components`` (the corpus-wide component set) so every bucket
+        reports the same metric keys — components idle this bucket report
+        baseline utilization, exactly like a real scrape would.
+        """
+        ops = dict(ops)
+        for c in components or ():
+            ops.setdefault(c, 0)
+        # Anomalous components must report even in zero-traffic buckets.
+        for a in self.anomalies:
+            ops.setdefault(a.component, 0)
+        samples: list[MetricSample] = []
+        for component in sorted(ops):
+            prof = self._profile(component)
+            n_ops = ops[component]
+            n_writes = writes.get(component, 0)
+
+            ema = self._ema.get(component, 0.0)
+            ema = 0.9 * ema + 0.1 * n_ops
+            self._ema[component] = ema
+
+            cpu = prof.base_cpu + prof.cpu_per_op * n_ops
+            wiops = float(n_writes)
+            wtp = n_writes * prof.kb_per_write
+
+            for a in self.anomalies:
+                if a.component == component and a.active(self._t):
+                    if a.kind == "cryptojacking":
+                        # pow.py-style CPU burner: large, traffic-independent
+                        cpu += 400.0 * a.magnitude
+                    elif a.kind == "ransomware":
+                        cpu += 80.0 * a.magnitude
+                        wiops += 200.0 * a.magnitude
+                        wtp += 200.0 * a.magnitude * prof.kb_per_write
+
+            cpu *= 1.0 + self.rng.normal(0.0, 0.03)
+            mem = prof.base_mem + prof.mem_per_activity * ema * 10.0
+            mem *= 1.0 + self.rng.normal(0.0, 0.01)
+
+            samples.append(MetricSample(component, "cpu", round(max(cpu, 0.0), 4)))
+            samples.append(MetricSample(component, "memory", round(max(mem, 0.0), 4)))
+            if is_stateful(component):
+                usage = self._usage.get(component, 50.0) + wtp / 1024.0
+                self._usage[component] = usage
+                samples.append(MetricSample(component, "write-iops", round(wiops, 4)))
+                samples.append(MetricSample(component, "write-tp", round(wtp, 4)))
+                samples.append(MetricSample(component, "usage", round(usage, 4)))
+        self._t += 1
+        return samples

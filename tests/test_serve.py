@@ -1,0 +1,105 @@
+"""Serving-layer tests: checkpoint-backed predictor, what-if estimation,
+anomaly detection on injected cryptojacking."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
+from deeprest_tpu.data.featurize import CallPathSpace, featurize_buckets
+from deeprest_tpu.data.synthesize import TraceSynthesizer
+from deeprest_tpu.serve import AnomalyDetector, Predictor, WhatIfEstimator
+from deeprest_tpu.train import Trainer, prepare_dataset
+from deeprest_tpu.workload import Anomaly, crypto_scenario, normal_scenario, simulate_corpus
+
+CFG = Config(
+    model=ModelConfig(hidden_size=8, dropout_rate=0.1),
+    train=TrainConfig(num_epochs=6, batch_size=16, window_size=12,
+                      eval_stride=12, eval_max_cycles=3, seed=0),
+)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Train a small model on a simulated corpus; return everything."""
+    scn = normal_scenario(0)
+    scn.calls_per_user = 0.3
+    corpus = simulate_corpus(scn, 150)
+    space = CallPathSpace(config=FeaturizeConfig(round_to=8))
+    data = featurize_buckets(corpus, space=space)
+    bundle = prepare_dataset(data, CFG.train)
+    trainer = Trainer(CFG, bundle.feature_dim, bundle.metric_names)
+    state, _ = trainer.fit(bundle)
+    ckpt_dir = str(tmp_path_factory.mktemp("ckpt"))
+    trainer.save(ckpt_dir, state, bundle)
+    return corpus, space, data, bundle, trainer, state, ckpt_dir
+
+
+def test_predictor_from_checkpoint(trained):
+    corpus, space, data, bundle, trainer, state, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    assert pred.metric_names == bundle.metric_names
+    series = pred.predict_series(data.traffic[:40])
+    assert series.shape == (40, bundle.num_metrics, 3)
+    assert np.isfinite(series).all()
+    # non-window-multiple lengths covered exactly once per step
+    series2 = pred.predict_series(data.traffic[:31])
+    assert series2.shape[0] == 31
+
+
+def test_predictor_short_series_raises(trained):
+    *_, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    with pytest.raises(ValueError, match="window"):
+        pred.predict_series(np.zeros((5, pred.model.config.feature_dim)))
+
+
+def test_whatif_estimate(trained):
+    corpus, space, data, bundle, trainer, state, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    synth = TraceSynthesizer(space).fit(corpus)
+    est = WhatIfEstimator(pred, synth)
+
+    compose = "nginx-thrift_/wrk2-api/post/compose"
+    read = "nginx-thrift_/wrk2-api/home-timeline/read"
+    traffic = [{compose: 10, read: 30}] * 24
+    result = est.estimate(traffic)
+    assert set(result) == set(bundle.metric_names)
+    for metric, qs in result.items():
+        assert set(qs) == {"q05", "q50", "q95"}
+        assert qs["q50"].shape == (24,)
+        assert np.isfinite(qs["q50"]).all()
+
+    # 3x scale should not predict lower peak utilization on the gateway
+    factors = est.scaling_factor(traffic, [{compose: 30, read: 90}] * 24)
+    assert factors["nginx-thrift_cpu"] > 0.9
+
+
+def test_anomaly_detection_end_to_end(trained):
+    """Inject cryptojacking into a fresh corpus; the detector must flag the
+    victim component's CPU and stay quiet on a clean corpus."""
+    corpus, space, data, bundle, trainer, state, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    detector = AnomalyDetector(pred, tolerance=0.10, min_run=5)
+
+    victim = "compose-post-service"
+    scn = crypto_scenario(9)
+    scn.calls_per_user = 0.3
+    bad = simulate_corpus(scn, 80, anomalies=[
+        Anomaly(kind="cryptojacking", component=victim, start=30, end=60)])
+    bad_data = featurize_buckets(bad, space=space)
+    observed = np.stack([bad_data.resources[m] for m in bundle.metric_names], -1)
+    reports = {r.metric: r for r in detector.check(bad_data.traffic, observed)}
+
+    assert reports[f"{victim}_cpu"].flagged
+    flag_at = reports[f"{victim}_cpu"].first_flag_index
+    assert flag_at is not None and 25 <= flag_at <= 62
+
+    clean_scn = normal_scenario(12)
+    clean_scn.calls_per_user = 0.3
+    clean = simulate_corpus(clean_scn, 80)
+    clean_data = featurize_buckets(clean, space=space)
+    clean_obs = np.stack([clean_data.resources[m] for m in bundle.metric_names], -1)
+    clean_reports = {r.metric: r for r in detector.check(clean_data.traffic, clean_obs)}
+    assert clean_reports[f"{victim}_cpu"].score < reports[f"{victim}_cpu"].score

@@ -1,0 +1,78 @@
+"""TraceSynthesizer tests: endpoint discovery, count preservation,
+determinism, feature-space compatibility."""
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.featurize import CallPathSpace, featurize_buckets
+from deeprest_tpu.data.synthesize import TraceSynthesizer
+from deeprest_tpu.workload import normal_scenario, simulate_corpus
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    scn = normal_scenario(0)
+    scn.calls_per_user = 0.3
+    return simulate_corpus(scn, 60)
+
+
+@pytest.fixture(scope="module")
+def synth(corpus):
+    space = CallPathSpace(config=FeaturizeConfig(round_to=1))
+    return TraceSynthesizer(space).fit(corpus)
+
+
+def test_endpoints_discovered(synth):
+    eps = synth.endpoints
+    assert "nginx-thrift_/wrk2-api/post/compose" in eps
+    assert "nginx-thrift_/wrk2-api/home-timeline/read" in eps
+    assert "media-frontend_/upload-media" in eps
+
+
+def test_root_counts_preserved(synth):
+    """Every sampled per-trace vector has root-path count exactly 1, so the
+    synthesized vector's root column equals the requested call count."""
+    rng = np.random.default_rng(0)
+    for ep in synth.endpoints[:3]:
+        x = synth.synthesize({ep: 17}, rng)
+        root_col = synth.space.column_of((ep,))
+        assert x[root_col] == 17.0
+        assert x.sum() >= 17.0  # children add more
+
+
+def test_mixed_traffic(synth):
+    eps = synth.endpoints
+    x = synth.synthesize({eps[0]: 5, eps[1]: 3}, np.random.default_rng(1))
+    assert x[synth.space.column_of((eps[0],))] == 5.0
+    assert x[synth.space.column_of((eps[1],))] == 3.0
+
+
+def test_zero_and_unknown(synth):
+    x = synth.synthesize({synth.endpoints[0]: 0}, np.random.default_rng(0))
+    assert x.sum() == 0.0
+    with pytest.raises(KeyError, match="unknown API endpoint"):
+        synth.synthesize({"nope_/x": 1})
+
+
+def test_series_deterministic(synth):
+    traffic = [{synth.endpoints[0]: 4, synth.endpoints[1]: 2}] * 5
+    a = synth.synthesize_series(traffic, seed=7)
+    b = synth.synthesize_series(traffic, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (5, synth.space.capacity)
+
+
+def test_feature_columns_compatible(corpus, synth):
+    """Synthesized vectors live in the same column space as the corpus
+    featurization when sharing one CallPathSpace."""
+    data = featurize_buckets(corpus, space=synth.space)
+    assert data.traffic.shape[1] == synth.space.capacity
+    # a synthesized "replay" of bucket 0's endpoint mix lands on the same
+    # nonzero support (root columns at least)
+    roots = {}
+    for trace in corpus[0].traces:
+        roots[trace.label] = roots.get(trace.label, 0) + 1
+    x = synth.synthesize(roots, np.random.default_rng(0))
+    for ep, count in roots.items():
+        assert x[synth.space.column_of((ep,))] == count
