@@ -13,8 +13,10 @@ TPU-first departures from the reference:
   Vectors are materialized at a fixed ``capacity`` (rounded up to an MXU-lane
   multiple) so a growing vocabulary never changes array shapes mid-run.
 - **Hash-bucketing mode.**  For streaming/10k-endpoint corpora the dictionary
-  is replaced by a stable BLAKE2 hash of the call path into ``capacity``
-  buckets: no global vocabulary pass, no recompile, multi-host consistent.
+  is replaced by a seeded FNV-1a hash of the call path into ``capacity``
+  buckets: no global vocabulary pass, no recompile, multi-host and
+  cross-language consistent (native/featurizer.cpp implements the same
+  function).
 - **Streaming API.**  ``observe``/``extract`` work bucket-at-a-time so the
   continuous-retrain mode can featurize a live firehose.
 """
@@ -22,7 +24,6 @@ TPU-first departures from the reference:
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -33,13 +34,23 @@ from deeprest_tpu.data.schema import Bucket, Span
 CallPath = tuple[str, ...]
 
 
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_SEED_MIX = 0x9E3779B97F4A7C15
+_MASK64 = (1 << 64) - 1
+
+
 def _stable_hash(path: CallPath, seed: int) -> int:
-    h = hashlib.blake2b(
-        "\x1f".join(path).encode("utf-8"),
-        digest_size=8,
-        key=seed.to_bytes(8, "little", signed=False),
-    )
-    return int.from_bytes(h.digest(), "little")
+    """Seeded FNV-1a over the \\x1f-joined call path.
+
+    Deliberately simple: the native C++ featurizer (native/featurizer.cpp)
+    implements the identical function so hash-mode columns are consistent
+    across languages and hosts.
+    """
+    h = _FNV_OFFSET ^ ((seed * _SEED_MIX) & _MASK64)
+    for b in "\x1f".join(path).encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
 
 
 def _round_up(n: int, multiple: int) -> int:

@@ -1,0 +1,119 @@
+"""ctypes binding for the native C++ featurization ETL.
+
+Loads ``native/libdeeprest_etl.so`` (built via ``make -C native``) and
+exposes :func:`featurize_jsonl`, which matches
+:func:`deeprest_tpu.data.featurize.featurize_buckets` output exactly but
+streams the corpus twice through the C++ parser instead of materializing
+Python span trees — the fast path for month-scale corpora.  Falls back to
+the pure-Python pipeline when the library isn't built (``require_native``
+turns that into an error).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.featurize import CallPathSpace, FeaturizedData, featurize_buckets
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Env override first so sanitizer/instrumented builds can be forced even
+# when the default library exists.
+_LIB_CANDIDATES = (
+    os.environ.get("DEEPREST_ETL_LIB", ""),
+    os.path.join(_REPO_ROOT, "native", "libdeeprest_etl.so"),
+)
+
+_lib: ctypes.CDLL | None = None
+_lib_checked = False
+
+
+def load_library() -> ctypes.CDLL | None:
+    global _lib, _lib_checked
+    if _lib_checked:
+        return _lib
+    _lib_checked = True
+    for path in _LIB_CANDIDATES:
+        if path and os.path.exists(path):
+            lib = ctypes.CDLL(path)
+            lib.drft_featurize_file.restype = ctypes.c_int
+            lib.drft_featurize_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_longlong, ctypes.c_longlong, ctypes.c_ulonglong,
+                ctypes.c_char_p, ctypes.c_longlong,
+            ]
+            lib.drft_stable_hash.restype = ctypes.c_ulonglong
+            lib.drft_stable_hash.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong]
+            _lib = lib
+            break
+    return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def stable_hash_native(joined: str, seed: int) -> int:
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native ETL library not built (make -C native)")
+    return int(lib.drft_stable_hash(joined.encode("utf-8"), seed))
+
+
+def featurize_jsonl(
+    path: str,
+    config: FeaturizeConfig | None = None,
+    require_native: bool = False,
+) -> FeaturizedData:
+    """Featurize a JSONL corpus via the native ETL (or Python fallback)."""
+    config = config or FeaturizeConfig()
+    lib = load_library()
+    if lib is None:
+        if require_native:
+            raise RuntimeError("native ETL library not built (make -C native)")
+        from deeprest_tpu.data.schema import load_raw_data
+
+        return featurize_buckets(load_raw_data(path), config)
+
+    with tempfile.TemporaryDirectory(prefix="drft_etl_") as out_dir:
+        err = ctypes.create_string_buffer(1024)
+        rc = lib.drft_featurize_file(
+            path.encode("utf-8"), out_dir.encode("utf-8"),
+            1 if config.hash_features else 0,
+            config.capacity, config.round_to, config.hash_seed,
+            err, len(err),
+        )
+        if rc != 0:
+            raise ValueError(f"native featurize failed: {err.value.decode()}")
+
+        with open(os.path.join(out_dir, "header.json"), encoding="utf-8") as f:
+            header = json.load(f)
+        t, cap = header["num_buckets"], header["capacity"]
+        metric_keys = header["metric_keys"]
+        components = header["components"]
+
+        def load(name, cols):
+            arr = np.fromfile(os.path.join(out_dir, name), dtype="<f4")
+            return arr.reshape(t, cols)
+
+        traffic = load("traffic.bin", cap)
+        resources_mat = load("resources.bin", len(metric_keys))
+        invocations_mat = load("invocations.bin", len(components))
+
+    space = CallPathSpace(config=config)
+    space.frozen_capacity = cap
+    if not config.hash_features:
+        space.index = {
+            tuple(key.split("\x1f")): i for i, key in enumerate(header["vocab"])
+        }
+    return FeaturizedData(
+        traffic=traffic,
+        resources={k: resources_mat[:, i].copy() for i, k in enumerate(metric_keys)},
+        invocations={c: invocations_mat[:, i].copy() for i, c in enumerate(components)},
+        space=space,
+    )

@@ -1,0 +1,593 @@
+// Native featurization ETL: JSONL telemetry corpus -> model-ready arrays.
+//
+// This is the TPU-era equivalent of the reference's native data plane: where
+// the reference generates telemetry with C++ microservices and leaves the
+// Jaeger/Prometheus -> raw_data.pkl ETL implicit (SURVEY.md L2 "important
+// gap"), this library makes the ETL an explicit, fast, streaming native
+// component.  Semantics mirror deeprest_tpu/data/featurize.py exactly
+// (reference behavior: resource-estimation/featurize.py:11-106):
+//
+//   pass 1: stream buckets, build the call-path vocabulary (first-seen
+//           order), metric-key list (validated identical per bucket), and
+//           component set;
+//   pass 2: stream again, emitting per-bucket path-count vectors at a fixed
+//           capacity, resource series, and per-component invocation counts.
+//
+// Hash mode uses the same seeded FNV-1a as the Python side, so columns are
+// identical across languages.  Output: <out_dir>/header.json + raw float32
+// little-endian arrays (traffic.bin [T,capacity], resources.bin [T,M],
+// invocations.bin [T,C]).
+//
+// Build: make -C native   (g++ -O3 -shared; tsan variant available).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <unordered_set>
+#include <fstream>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- errors
+
+struct ParseError : std::runtime_error {
+    using std::runtime_error::runtime_error;
+};
+
+// ------------------------------------------------------------ JSON parse
+//
+// Minimal recursive-descent parser for the bucket schema only.  Tolerates
+// arbitrary key order and unknown keys; strings support \" \\ \/ \b \f \n
+// \r \t and \uXXXX (decoded to UTF-8).
+
+struct Span {
+    std::string component;
+    std::string operation;
+    std::vector<Span> children;
+};
+
+struct Metric {
+    std::string component;
+    std::string resource;
+    double value = 0.0;
+};
+
+struct Bucket {
+    std::vector<Metric> metrics;
+    std::vector<Span> traces;
+};
+
+class Parser {
+  public:
+    Parser(const char* begin, const char* end)
+        : begin_(begin), p_(begin), end_(end) {}
+
+    Bucket parse_bucket() {
+        Bucket b;
+        skip_ws();
+        expect('{');
+        bool first = true;
+        while (true) {
+            skip_ws();
+            if (peek() == '}') { ++p_; break; }
+            if (!first) { expect(','); skip_ws(); }
+            first = false;
+            std::string key = parse_string();
+            skip_ws();
+            expect(':');
+            skip_ws();
+            if (key == "metrics") {
+                parse_array([&] { b.metrics.push_back(parse_metric()); });
+            } else if (key == "traces") {
+                parse_array([&] { b.traces.push_back(parse_span()); });
+            } else {
+                skip_value();
+            }
+        }
+        return b;
+    }
+
+  private:
+    const char* begin_;
+    const char* p_;
+    const char* end_;
+
+    [[noreturn]] void fail(const std::string& what) {
+        throw ParseError(what + " at byte offset " +
+                         std::to_string(static_cast<long>(p_ - begin_)));
+    }
+    char peek() {
+        if (p_ >= end_) fail("unexpected end of input");
+        return *p_;
+    }
+    void expect(char c) {
+        if (peek() != c) fail(std::string("expected '") + c + "', got '" + *p_ + "'");
+        ++p_;
+    }
+    void skip_ws() {
+        while (p_ < end_ && (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' || *p_ == '\r')) ++p_;
+    }
+
+    template <typename F>
+    void parse_array(F&& element) {
+        expect('[');
+        skip_ws();
+        if (peek() == ']') { ++p_; return; }
+        while (true) {
+            skip_ws();
+            element();
+            skip_ws();
+            if (peek() == ']') { ++p_; return; }
+            expect(',');
+        }
+    }
+
+    Metric parse_metric() {
+        Metric m;
+        expect('{');
+        bool first = true;
+        while (true) {
+            skip_ws();
+            if (peek() == '}') { ++p_; break; }
+            if (!first) { expect(','); skip_ws(); }
+            first = false;
+            std::string key = parse_string();
+            skip_ws(); expect(':'); skip_ws();
+            if (key == "component") m.component = parse_string();
+            else if (key == "resource") m.resource = parse_string();
+            else if (key == "value") m.value = parse_number();
+            else skip_value();
+        }
+        return m;
+    }
+
+    Span parse_span() {
+        Span s;
+        expect('{');
+        bool first = true;
+        while (true) {
+            skip_ws();
+            if (peek() == '}') { ++p_; break; }
+            if (!first) { expect(','); skip_ws(); }
+            first = false;
+            std::string key = parse_string();
+            skip_ws(); expect(':'); skip_ws();
+            if (key == "component") s.component = parse_string();
+            else if (key == "operation") s.operation = parse_string();
+            else if (key == "children") parse_array([&] { s.children.push_back(parse_span()); });
+            else skip_value();
+        }
+        return s;
+    }
+
+    std::string parse_string() {
+        expect('"');
+        std::string out;
+        while (true) {
+            if (p_ >= end_) fail("unterminated string");
+            char c = *p_++;
+            if (c == '"') return out;
+            if (c != '\\') { out.push_back(c); continue; }
+            if (p_ >= end_) fail("dangling escape");
+            char e = *p_++;
+            switch (e) {
+                case '"': out.push_back('"'); break;
+                case '\\': out.push_back('\\'); break;
+                case '/': out.push_back('/'); break;
+                case 'b': out.push_back('\b'); break;
+                case 'f': out.push_back('\f'); break;
+                case 'n': out.push_back('\n'); break;
+                case 'r': out.push_back('\r'); break;
+                case 't': out.push_back('\t'); break;
+                case 'u': {
+                    uint32_t code = parse_hex4();
+                    // Surrogate pair: decode to the astral code point, same
+                    // as Python's json.loads, so call-path bytes agree
+                    // across languages for non-BMP characters.
+                    if (code >= 0xD800 && code <= 0xDBFF) {
+                        if (p_ + 6 <= end_ && p_[0] == '\\' && p_[1] == 'u') {
+                            p_ += 2;
+                            uint32_t low = parse_hex4();
+                            if (low >= 0xDC00 && low <= 0xDFFF) {
+                                code = 0x10000 + ((code - 0xD800) << 10) +
+                                       (low - 0xDC00);
+                            } else {
+                                fail("unpaired high surrogate");
+                            }
+                        } else {
+                            fail("unpaired high surrogate");
+                        }
+                    } else if (code >= 0xDC00 && code <= 0xDFFF) {
+                        fail("unpaired low surrogate");
+                    }
+                    if (code < 0x80) {
+                        out.push_back(static_cast<char>(code));
+                    } else if (code < 0x800) {
+                        out.push_back(static_cast<char>(0xC0 | (code >> 6)));
+                        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+                    } else if (code < 0x10000) {
+                        out.push_back(static_cast<char>(0xE0 | (code >> 12)));
+                        out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+                    } else {
+                        out.push_back(static_cast<char>(0xF0 | (code >> 18)));
+                        out.push_back(static_cast<char>(0x80 | ((code >> 12) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+                        out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+                    }
+                    break;
+                }
+                default: fail("unknown escape");
+            }
+        }
+    }
+
+    uint32_t parse_hex4() {
+        uint32_t code = 0;
+        for (int i = 0; i < 4; ++i) {
+            if (p_ >= end_) fail("truncated \\u escape");
+            char h = *p_++;
+            code <<= 4;
+            if (h >= '0' && h <= '9') code |= h - '0';
+            else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+            else fail("bad \\u escape");
+        }
+        return code;
+    }
+
+    double parse_number() {
+        const char* start = p_;
+        while (p_ < end_ && (std::isdigit(static_cast<unsigned char>(*p_)) ||
+                             *p_ == '-' || *p_ == '+' || *p_ == '.' ||
+                             *p_ == 'e' || *p_ == 'E'))
+            ++p_;
+        if (p_ == start) fail("expected number");
+        std::string text(start, p_);
+        try {
+            return std::stod(text);
+        } catch (const std::out_of_range&) {
+            // Match Python json.loads: overflow saturates to +/-inf,
+            // underflow to 0.
+            bool neg = text[0] == '-';
+            bool tiny = text.find("e-") != std::string::npos ||
+                        text.find("E-") != std::string::npos;
+            if (tiny) return neg ? -0.0 : 0.0;
+            return neg ? -HUGE_VAL : HUGE_VAL;
+        } catch (const std::exception&) {
+            fail("bad number '" + text + "'");
+        }
+    }
+
+    void skip_value() {
+        skip_ws();
+        char c = peek();
+        if (c == '"') { parse_string(); return; }
+        if (c == '{') {
+            ++p_;
+            int depth = 1;
+            while (depth > 0) {
+                c = peek();
+                if (c == '"') { parse_string(); continue; }
+                if (c == '{' || c == '[') ++depth;
+                if (c == '}' || c == ']') --depth;
+                ++p_;
+            }
+            return;
+        }
+        if (c == '[') {
+            ++p_;
+            int depth = 1;
+            while (depth > 0) {
+                c = peek();
+                if (c == '"') { parse_string(); continue; }
+                if (c == '{' || c == '[') ++depth;
+                if (c == '}' || c == ']') --depth;
+                ++p_;
+            }
+            return;
+        }
+        // literal: number / true / false / null
+        while (p_ < end_ && *p_ != ',' && *p_ != '}' && *p_ != ']') ++p_;
+    }
+};
+
+// ----------------------------------------------------------- stable hash
+// Must match deeprest_tpu/data/featurize.py::_stable_hash exactly.
+
+constexpr uint64_t kFnvOffset = 0xCBF29CE484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001B3ULL;
+constexpr uint64_t kSeedMix = 0x9E3779B97F4A7C15ULL;
+
+uint64_t stable_hash(const std::string& joined, uint64_t seed) {
+    uint64_t h = kFnvOffset ^ (seed * kSeedMix);
+    for (unsigned char b : joined) {
+        h ^= b;
+        h *= kFnvPrime;
+    }
+    return h;
+}
+
+// ------------------------------------------------------------ featurizer
+
+constexpr char kPathSep = '\x1f';
+
+struct Config {
+    bool hash_mode = false;
+    int64_t capacity = 0;   // 0 => observed size rounded up (dict mode only)
+    int64_t round_to = 128;
+    uint64_t seed = 0x5EED;
+};
+
+size_t round_up(size_t n, size_t multiple) {
+    if (multiple <= 1) return n > 0 ? n : 1;
+    size_t m = (n + multiple - 1) / multiple * multiple;
+    return m > multiple ? m : multiple;
+}
+
+struct Vocab {
+    std::unordered_map<std::string, int64_t> index;  // joined path -> column
+    std::vector<std::string> ordered;                // first-seen order
+
+    int64_t observe(const std::string& key) {
+        auto it = index.find(key);
+        if (it != index.end()) return it->second;
+        int64_t col = static_cast<int64_t>(ordered.size());
+        index.emplace(key, col);
+        ordered.push_back(key);
+        return col;
+    }
+};
+
+struct CorpusStats {
+    Vocab vocab;
+    std::vector<std::string> metric_keys;            // first-bucket order
+    std::unordered_map<std::string, int64_t> metric_idx;
+    Vocab components;                                // component -> idx
+    int64_t num_buckets = 0;
+};
+
+void walk_observe(const Span& s, std::string& prefix, CorpusStats& stats) {
+    size_t saved = prefix.size();
+    if (!prefix.empty()) prefix.push_back(kPathSep);
+    prefix += s.component;
+    prefix.push_back('_');
+    prefix += s.operation;
+    stats.vocab.observe(prefix);
+    stats.components.observe(s.component);
+    for (const Span& c : s.children) walk_observe(c, prefix, stats);
+    prefix.resize(saved);
+}
+
+struct Extractor {
+    const CorpusStats& stats;
+    const Config& cfg;
+    size_t capacity;
+
+    int64_t column_of(const std::string& joined) const {
+        if (cfg.hash_mode) {
+            return static_cast<int64_t>(stable_hash(joined, cfg.seed) % capacity);
+        }
+        auto it = stats.vocab.index.find(joined);
+        if (it == stats.vocab.index.end() ||
+            it->second >= static_cast<int64_t>(capacity))
+            return -1;  // overflow: dropped (documented policy)
+        return it->second;
+    }
+
+    void walk_extract(const Span& s, std::string& prefix, float* row,
+                      float* inv_row) const {
+        size_t saved = prefix.size();
+        if (!prefix.empty()) prefix.push_back(kPathSep);
+        prefix += s.component;
+        prefix.push_back('_');
+        prefix += s.operation;
+        int64_t col = column_of(prefix);
+        if (col >= 0) row[col] += 1.0f;
+        auto cit = stats.components.index.find(s.component);
+        if (cit != stats.components.index.end()) inv_row[cit->second] += 1.0f;
+        for (const Span& c : s.children) walk_extract(c, prefix, row, inv_row);
+        prefix.resize(saved);
+    }
+};
+
+std::string json_escape(const std::string& s) {
+    std::string out;
+    for (char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            case kPathSep: out += "\\u001f"; break;
+            default:
+                if (static_cast<unsigned char>(c) < 0x20) {
+                    char buf[8];
+                    std::snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out.push_back(c);
+                }
+        }
+    }
+    return out;
+}
+
+template <typename Fn>
+void for_each_line(const std::string& path, Fn&& fn) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) throw ParseError("cannot open input file: " + path);
+    std::string line;
+    int64_t lineno = 0;
+    while (std::getline(in, line)) {
+        ++lineno;
+        bool blank = true;
+        for (char c : line)
+            if (c != ' ' && c != '\t' && c != '\r') { blank = false; break; }
+        if (blank) continue;
+        try {
+            fn(line, lineno);
+        } catch (ParseError& e) {
+            throw ParseError("line " + std::to_string(lineno) + ": " + e.what());
+        }
+    }
+}
+
+void featurize_file(const std::string& in_path, const std::string& out_dir,
+                    const Config& cfg) {
+    if (cfg.hash_mode && cfg.capacity <= 0)
+        throw ParseError("hash mode requires an explicit capacity > 0");
+
+    // ---- pass 1: vocabulary / metric keys / components ----
+    CorpusStats stats;
+    for_each_line(in_path, [&](const std::string& line, int64_t) {
+        Parser parser(line.data(), line.data() + line.size());
+        Bucket b = parser.parse_bucket();
+        std::vector<std::string> keys;
+        keys.reserve(b.metrics.size());
+        for (const Metric& m : b.metrics) keys.push_back(m.component + "_" + m.resource);
+        std::unordered_set<std::string> seen;
+        for (const std::string& k : keys)
+            if (!seen.insert(k).second)
+                throw ParseError("duplicate metric " + k);
+        if (stats.num_buckets == 0) {
+            stats.metric_keys = keys;
+            for (size_t i = 0; i < keys.size(); ++i)
+                stats.metric_idx.emplace(keys[i], i);
+        } else {
+            if (keys.size() != stats.metric_keys.size())
+                throw ParseError("metric keys diverge from bucket 0 (count)");
+            for (const std::string& k : keys)
+                if (stats.metric_idx.find(k) == stats.metric_idx.end())
+                    throw ParseError("metric keys diverge from bucket 0: " + k);
+        }
+        std::string prefix;
+        for (const Span& t : b.traces) walk_observe(t, prefix, stats);
+        ++stats.num_buckets;
+    });
+    // Empty corpora are valid (Python featurize_buckets([]) returns empty
+    // arrays); all loops below degrade to zero rows.
+
+    size_t capacity = cfg.capacity > 0
+        ? static_cast<size_t>(cfg.capacity)
+        : round_up(stats.vocab.ordered.size(), static_cast<size_t>(cfg.round_to));
+
+    const size_t T = static_cast<size_t>(stats.num_buckets);
+    const size_t M = stats.metric_keys.size();
+    const size_t C = stats.components.ordered.size() + 1;  // + "general"
+    const size_t general_idx = C - 1;
+
+    std::vector<float> traffic(T * capacity, 0.0f);
+    std::vector<float> resources(T * M, 0.0f);
+    std::vector<float> invocations(T * C, 0.0f);
+
+    // ---- pass 2: extraction ----
+    Extractor ex{stats, cfg, capacity};
+    int64_t t = 0;
+    for_each_line(in_path, [&](const std::string& line, int64_t) {
+        Parser parser(line.data(), line.data() + line.size());
+        Bucket b = parser.parse_bucket();
+        float* row = traffic.data() + t * capacity;
+        float* inv_row = invocations.data() + t * C;
+        std::string prefix;
+        for (const Span& tr : b.traces) {
+            ex.walk_extract(tr, prefix, row, inv_row);
+            inv_row[general_idx] += 1.0f;
+        }
+        float* res_row = resources.data() + t * M;
+        for (const Metric& m : b.metrics)
+            res_row[stats.metric_idx.at(m.component + "_" + m.resource)] =
+                static_cast<float>(m.value);
+        ++t;
+    });
+
+    // ---- write outputs ----
+    auto write_bin = [&](const std::string& name, const std::vector<float>& v) {
+        std::ofstream out(out_dir + "/" + name, std::ios::binary);
+        if (!out) throw ParseError("cannot write " + out_dir + "/" + name);
+        out.write(reinterpret_cast<const char*>(v.data()),
+                  static_cast<std::streamsize>(v.size() * sizeof(float)));
+    };
+    write_bin("traffic.bin", traffic);
+    write_bin("resources.bin", resources);
+    write_bin("invocations.bin", invocations);
+
+    std::ofstream hdr(out_dir + "/header.json");
+    if (!hdr) throw ParseError("cannot write header.json");
+    hdr << "{\"num_buckets\":" << T << ",\"capacity\":" << capacity
+        << ",\"hash_mode\":" << (cfg.hash_mode ? "true" : "false")
+        << ",\"metric_keys\":[";
+    for (size_t i = 0; i < M; ++i)
+        hdr << (i ? "," : "") << '"' << json_escape(stats.metric_keys[i]) << '"';
+    hdr << "],\"components\":[";
+    for (size_t i = 0; i + 1 < C; ++i)
+        hdr << (i ? "," : "") << '"' << json_escape(stats.components.ordered[i]) << '"';
+    hdr << (C > 1 ? "," : "") << "\"general\"]";
+    hdr << ",\"vocab\":[";
+    if (!cfg.hash_mode) {
+        for (size_t i = 0; i < stats.vocab.ordered.size(); ++i)
+            hdr << (i ? "," : "") << '"' << json_escape(stats.vocab.ordered[i]) << '"';
+    }
+    hdr << "]}";
+}
+
+}  // namespace
+
+// --------------------------------------------------------------- C ABI
+
+extern "C" {
+
+// Returns 0 on success; on failure returns 1 and fills err (NUL-terminated).
+int drft_featurize_file(const char* jsonl_path, const char* out_dir,
+                        int hash_mode, long long capacity, long long round_to,
+                        unsigned long long seed, char* err, long long err_len) {
+    try {
+        Config cfg;
+        cfg.hash_mode = hash_mode != 0;
+        cfg.capacity = capacity;
+        cfg.round_to = round_to;
+        cfg.seed = seed;
+        featurize_file(jsonl_path, out_dir, cfg);
+        return 0;
+    } catch (const std::exception& e) {
+        if (err && err_len > 0) {
+            std::strncpy(err, e.what(), static_cast<size_t>(err_len - 1));
+            err[err_len - 1] = '\0';
+        }
+        return 1;
+    }
+}
+
+// Hash self-test hook so Python can assert cross-language consistency.
+unsigned long long drft_stable_hash(const char* joined, unsigned long long seed) {
+    return stable_hash(std::string(joined), seed);
+}
+
+}  // extern "C"
+
+#ifdef DRFT_SELFTEST_MAIN
+// Standalone driver for sanitizer runs (a TSan-instrumented shared object
+// cannot be dlopen'ed into an uninstrumented Python process).
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        std::fprintf(stderr, "usage: %s <in.jsonl> <out_dir>\n", argv[0]);
+        return 2;
+    }
+    char err[1024];
+    int rc = drft_featurize_file(argv[1], argv[2], 0, 0, 128, 0x5EED,
+                                 err, sizeof err);
+    if (rc != 0) {
+        std::fprintf(stderr, "featurize failed: %s\n", err);
+        return 1;
+    }
+    std::printf("selftest-ok\n");
+    return 0;
+}
+#endif
