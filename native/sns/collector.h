@@ -1,0 +1,54 @@
+#pragma once
+
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace sns {
+
+struct CollectorOptions {
+  int port = 0;
+  int interval_ms = 5000;  // scrape window = ML time-step (SURVEY.md §5.5)
+  int grace_ms = 1000;     // quiet time before a trace is considered complete
+  std::string output_path = "raw_data.jsonl";
+};
+
+struct ProcSample {
+  double cpu_seconds = 0;     // cumulative utime+stime (seconds)
+  double rss_mb = 0;
+  double write_bytes = 0;     // cumulative
+  double write_syscalls = 0;  // cumulative
+  bool ok = false;
+};
+
+struct PendingTrace {
+  std::vector<SpanRecord> spans;
+  uint64_t last_update_ns = 0;
+};
+
+class Collector {
+ public:
+  Collector(ClusterConfig* config, CollectorOptions options);
+  void Run(const std::atomic<bool>& running);  // blocks
+  void RegisterProcess(const std::string& component, int pid);
+  void Ingest(const Json& frame);      // span batch or registration frame
+  Json CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns);
+
+ private:
+  void IngestLoop(const std::atomic<bool>& running);
+
+  ClusterConfig* config_;
+  CollectorOptions options_;
+  std::mutex mu_;
+  std::map<std::string, int> watched_;  // component -> pid
+  std::unordered_map<uint64_t, PendingTrace> pending_;
+  std::map<std::string, ProcSample> last_samples_;
+};
+
+}  // namespace sns

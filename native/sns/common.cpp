@@ -1,0 +1,525 @@
+#include "common.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <stdexcept>
+
+namespace sns {
+
+LogLevel g_log_level = LogLevel::Warning;
+
+void LogLine(LogLevel level, const std::string& msg) {
+  static const char* kNames[] = {"debug", "info", "warning", "error"};
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  std::cerr << "[" << kNames[static_cast<int>(level)] << "] "
+            << SpanSink::Get().component() << ": " << msg << "\n";
+}
+
+uint64_t NowNs() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+uint64_t MonoNs() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull + ts.tv_nsec;
+}
+
+uint64_t RandomU64() {
+  thread_local uint64_t state = [] {
+    uint64_t seed = 0;
+    std::ifstream urandom("/dev/urandom", std::ios::binary);
+    urandom.read(reinterpret_cast<char*>(&seed), sizeof seed);
+    seed ^= NowNs() ^ (reinterpret_cast<uintptr_t>(&seed) << 16);
+    return seed ? seed : 0x9e3779b97f4a7c15ull;
+  }();
+  state ^= state << 13;
+  state ^= state >> 7;
+  state ^= state << 17;
+  return state;
+}
+
+// ---------------------------------------------------------------------------
+// FramedSocket
+
+FramedSocket::~FramedSocket() { Close(); }
+
+void FramedSocket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+std::unique_ptr<FramedSocket> FramedSocket::Connect(const std::string& host,
+                                                    int port, int timeout_ms) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) != 0 || !res)
+    return nullptr;
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    return nullptr;
+  }
+  // Non-blocking connect with timeout, then back to blocking IO.
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    rc = poll(&pfd, 1, timeout_ms);
+    int err = 0;
+    socklen_t len = sizeof err;
+    if (rc <= 0 || getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err) {
+      ::close(fd);
+      return nullptr;
+    }
+  } else if (rc != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  fcntl(fd, F_SETFL, flags);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return std::make_unique<FramedSocket>(fd);
+}
+
+bool FramedSocket::WriteAll(const char* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd_, data, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    data += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool FramedSocket::ReadAll(char* data, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd_, data, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    data += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool FramedSocket::WriteFrame(const std::string& payload) {
+  if (fd_ < 0) return false;
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  char hdr[4];
+  memcpy(hdr, &len, 4);
+  return WriteAll(hdr, 4) && WriteAll(payload.data(), payload.size());
+}
+
+bool FramedSocket::ReadFrame(std::string* payload) {
+  if (fd_ < 0) return false;
+  char hdr[4];
+  if (!ReadAll(hdr, 4)) return false;
+  uint32_t len;
+  memcpy(&len, hdr, 4);
+  len = ntohl(len);
+  if (len > (64u << 20)) return false;
+  payload->resize(len);
+  return len == 0 || ReadAll(payload->data(), len);
+}
+
+int ListenOn(int port, int backlog) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("socket() failed");
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    ::close(fd);
+    throw std::runtime_error("bind(" + std::to_string(port) + ") failed: " +
+                             strerror(errno));
+  }
+  if (listen(fd, backlog) != 0) {
+    ::close(fd);
+    throw std::runtime_error("listen() failed");
+  }
+  return fd;
+}
+
+// ---------------------------------------------------------------------------
+// SpanSink
+
+SpanSink& SpanSink::Get() {
+  static SpanSink* sink = new SpanSink();
+  return *sink;
+}
+
+void SpanSink::Configure(const std::string& component,
+                         const std::string& collector_host, int collector_port) {
+  component_ = component;
+  host_ = collector_host;
+  port_ = collector_port;
+  if (port_ > 0 && !running_.exchange(true))
+    flusher_ = std::thread([this] { FlushLoop(); });
+}
+
+void SpanSink::Record(SpanRecord span) {
+  if (port_ <= 0) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  if (buffer_.size() < kMaxBuffered) buffer_.push_back(std::move(span));
+}
+
+void SpanSink::FlushLoop() {
+  while (running_) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    Flush();
+  }
+}
+
+void SpanSink::Flush() {
+  std::vector<SpanRecord> batch;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    batch.swap(buffer_);
+  }
+  if (!batch.empty() && !SendBatch(std::move(batch)))
+    conn_.reset();  // reconnect next time; batch dropped (lossy by design)
+}
+
+bool SpanSink::SendBatch(std::vector<SpanRecord> batch) {
+  if (!conn_ || !conn_->ok()) {
+    conn_ = FramedSocket::Connect(host_, port_);
+    if (!conn_) return false;
+  }
+  JsonArray spans;
+  spans.reserve(batch.size());
+  for (const auto& s : batch) {
+    JsonObject o;
+    o["tid"] = Json(s.trace_id);
+    o["sid"] = Json(s.span_id);
+    o["pid"] = Json(s.parent_id);
+    o["c"] = Json(s.component);
+    o["o"] = Json(s.operation);
+    o["b"] = Json(s.start_ns);
+    o["e"] = Json(s.end_ns);
+    spans.push_back(Json(std::move(o)));
+  }
+  return conn_->WriteFrame(Json(std::move(spans)).dump());
+}
+
+void SpanSink::Shutdown() {
+  if (running_.exchange(false)) {
+    if (flusher_.joinable()) flusher_.join();
+    Flush();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// ScopedSpan
+
+ScopedSpan::ScopedSpan(const TraceContext& parent, const std::string& operation,
+                       const std::string& component)
+    : sampled_(parent.sampled) {
+  // Ids are masked to 63 bits so they stay exact through the Int-typed JSON
+  // transport (int64 end-to-end).
+  constexpr uint64_t kIdMask = 0x7FFFFFFFFFFFFFFFull;
+  span_.trace_id = parent.trace_id ? parent.trace_id : (RandomU64() & kIdMask);
+  span_.span_id = RandomU64() & kIdMask;
+  span_.parent_id = parent.trace_id ? parent.span_id : 0;
+  span_.component = component.empty() ? SpanSink::Get().component() : component;
+  span_.operation = operation;
+  span_.start_ns = NowNs();
+  ctx_.trace_id = span_.trace_id;
+  ctx_.span_id = span_.span_id;
+  ctx_.sampled = sampled_;
+}
+
+ScopedSpan::~ScopedSpan() {
+  if (!sampled_) return;
+  span_.end_ns = NowNs();
+  SpanSink::Get().Record(std::move(span_));
+}
+
+// ---------------------------------------------------------------------------
+// Wire format
+
+std::string EncodeRequest(const std::string& method, const TraceContext& ctx,
+                          const Json& args) {
+  JsonObject o;
+  o["m"] = Json(method);
+  o["t"] = Json(JsonArray{Json(ctx.trace_id), Json(ctx.span_id),
+                          Json(ctx.sampled)});
+  o["a"] = args;
+  return Json(std::move(o)).dump();
+}
+
+bool DecodeRequest(const std::string& frame, RpcRequest* out) {
+  try {
+    Json j = Json::parse(frame);
+    out->method = j["m"].as_string();
+    const auto& t = j["t"].as_array();
+    if (t.size() == 3) {
+      out->ctx.trace_id = t[0].as_uint();
+      out->ctx.span_id = t[1].as_uint();
+      out->ctx.sampled = t[2].as_bool(true);
+    }
+    out->args = j["a"];
+    return !out->method.empty();
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+
+std::string EncodeResponse(bool ok, const std::string& error, const Json& result) {
+  JsonObject o;
+  o["ok"] = Json(ok);
+  if (!ok) o["e"] = Json(error);
+  o["r"] = result;
+  return Json(std::move(o)).dump();
+}
+
+bool DecodeResponse(const std::string& frame, bool* ok, std::string* error,
+                    Json* result) {
+  try {
+    Json j = Json::parse(frame);
+    *ok = j["ok"].as_bool();
+    *error = j["e"].as_string();
+    *result = j["r"];
+    return true;
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+
+int AcceptWithTimeout(int listen_fd, int timeout_ms) {
+  pollfd pfd{listen_fd, POLLIN, 0};
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc <= 0) return -1;
+  return accept(listen_fd, nullptr, nullptr);
+}
+
+// ---------------------------------------------------------------------------
+// RpcServer
+
+RpcServer::RpcServer(std::string component, int port)
+    : component_(std::move(component)), port_(port) {}
+
+void RpcServer::Register(const std::string& method, RpcHandler handler) {
+  handlers_[method] = std::move(handler);
+}
+
+void RpcServer::Serve() {
+  listen_fd_ = ListenOn(port_);
+  running_ = true;
+  SNS_LOG(LogLevel::Info, component_ + " listening on :" + std::to_string(port_));
+  while (running_) {
+    int fd = AcceptWithTimeout(listen_fd_, 200);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    uint64_t id = next_conn_id_++;
+    active_fds_[id] = fd;
+    conn_threads_.emplace(
+        id, std::thread([this, fd, id] { HandleConnection(fd, id); }));
+    // Join threads whose connections have already finished.
+    for (auto& t : done_threads_) t.join();
+    done_threads_.clear();
+  }
+  int lfd = listen_fd_.exchange(-1);
+  if (lfd >= 0) ::close(lfd);
+}
+
+void RpcServer::Start() {
+  accept_thread_ = std::thread([this] { Serve(); });
+  // Wait until the listener is live so callers can connect immediately.
+  while (!running_) std::this_thread::sleep_for(std::chrono::milliseconds(1));
+}
+
+void RpcServer::Stop() {
+  running_ = false;
+  if (accept_thread_.joinable()) accept_thread_.join();
+  // Unblock in-flight reads, then join every connection thread so no thread
+  // outlives the server object (TSan-clean shutdown).
+  std::map<uint64_t, std::thread> conns;
+  std::vector<std::thread> done;
+  {
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    for (auto& [id, fd] : active_fds_) ::shutdown(fd, SHUT_RDWR);
+    conns.swap(conn_threads_);
+    done.swap(done_threads_);
+  }
+  for (auto& [id, t] : conns) t.join();
+  for (auto& t : done) t.join();
+}
+
+void RpcServer::HandleConnection(int fd, uint64_t conn_id) {
+  FramedSocket sock(fd);
+  std::string frame;
+  while (running_ && sock.ReadFrame(&frame)) {
+    RpcRequest req;
+    if (!DecodeRequest(frame, &req)) {
+      sock.WriteFrame(EncodeResponse(false, "bad request", Json()));
+      continue;
+    }
+    auto it = handlers_.find(req.method);
+    if (it == handlers_.end()) {
+      sock.WriteFrame(EncodeResponse(false, "no such method: " + req.method, Json()));
+      continue;
+    }
+    // One server-side span per handled call (reference handler pattern:
+    // extract carrier, open child span — UserTimelineHandler.h:57-66).
+    std::string resp;
+    try {
+      ScopedSpan span(req.ctx, "/" + req.method, component_);
+      Json result = it->second(span.context(), req.args);
+      resp = EncodeResponse(true, "", result);
+    } catch (const std::exception& e) {
+      resp = EncodeResponse(false, e.what(), Json());
+    }
+    if (!sock.WriteFrame(resp)) break;
+  }
+  // Hand our thread handle to the reap list so the accept loop (or Stop)
+  // joins it, and free the fd slot (ids, not fds, key the maps — the kernel
+  // reuses fd numbers immediately).
+  std::lock_guard<std::mutex> lock(conn_mu_);
+  active_fds_.erase(conn_id);
+  auto it = conn_threads_.find(conn_id);
+  if (it != conn_threads_.end()) {
+    done_threads_.push_back(std::move(it->second));
+    conn_threads_.erase(it);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// RpcClient / ClientPool
+
+bool RpcClient::Connect() {
+  conn_ = FramedSocket::Connect(host_, port_);
+  return conn_ != nullptr;
+}
+
+Json RpcClient::Call(const std::string& method, const TraceContext& ctx,
+                     const Json& args) {
+  if (!connected() && !Connect())
+    throw std::runtime_error("connect to " + host_ + ":" + std::to_string(port_) +
+                             " failed");
+  if (!conn_->WriteFrame(EncodeRequest(method, ctx, args)))
+    throw std::runtime_error("rpc write failed");
+  std::string frame;
+  if (!conn_->ReadFrame(&frame)) throw std::runtime_error("rpc read failed");
+  bool ok;
+  std::string error;
+  Json result;
+  if (!DecodeResponse(frame, &ok, &error, &result))
+    throw std::runtime_error("rpc bad response frame");
+  if (!ok) throw std::runtime_error(method + ": " + error);
+  return result;
+}
+
+std::unique_ptr<RpcClient> ClientPool::Pop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  if (idle_.empty() && outstanding_ >= max_size_) {
+    // Pool exhausted: block with timeout, like the reference's
+    // ClientPool.h:89-97 (timeout -> typed error to the caller).
+    if (!cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms_),
+                      [this] { return !idle_.empty() || outstanding_ < max_size_; }))
+      throw std::runtime_error("client pool timeout for " + host_ + ":" +
+                               std::to_string(port_));
+  }
+  ++outstanding_;
+  if (!idle_.empty()) {
+    auto c = std::move(idle_.front());
+    idle_.pop_front();
+    return c;
+  }
+  lock.unlock();
+  return std::make_unique<RpcClient>(host_, port_);
+}
+
+void ClientPool::Push(std::unique_ptr<RpcClient> c) {
+  std::lock_guard<std::mutex> lock(mu_);
+  --outstanding_;
+  if (c) idle_.push_back(std::move(c));
+  cv_.notify_one();
+}
+
+Json ClientPool::Call(const std::string& method, const TraceContext& ctx,
+                      const Json& args) {
+  auto client = Pop();
+  try {
+    Json result = client->Call(method, ctx, args);
+    Push(std::move(client));
+    return result;
+  } catch (...) {
+    Push(nullptr);  // evict broken client (reference: ClientPool.h:138-146)
+    throw;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// ClusterConfig
+
+ClusterConfig ClusterConfig::Load(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot open config " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return FromJson(Json::parse(ss.str()));
+}
+
+ClusterConfig ClusterConfig::FromJson(const Json& j) {
+  ClusterConfig cfg;
+  for (const auto& [name, ep] : j["components"].as_object()) {
+    cfg.endpoints_[name] = Endpoint{ep["host"].as_string(),
+                                    static_cast<int>(ep["port"].as_int())};
+  }
+  if (j.has("secret")) cfg.secret_ = j["secret"].as_string();
+  return cfg;
+}
+
+Endpoint ClusterConfig::Lookup(const std::string& component) const {
+  auto it = endpoints_.find(component);
+  if (it == endpoints_.end())
+    throw std::runtime_error("unknown component: " + component);
+  return it->second;
+}
+
+ClientPool* ClusterConfig::PoolFor(const std::string& component) {
+  std::lock_guard<std::mutex> lock(*pools_mu_);
+  auto it = pools_.find(component);
+  if (it == pools_.end()) {
+    Endpoint ep = Lookup(component);
+    it = pools_.emplace(component,
+                        std::make_unique<ClientPool>(ep.host, ep.port)).first;
+  }
+  return it->second.get();
+}
+
+}  // namespace sns

@@ -1,0 +1,265 @@
+// Shared runtime for the native app plane: sockets + framed transport,
+// distributed-trace spans with cross-process context propagation, the RPC
+// server/client/connection-pool, and cluster config.
+//
+// Role-for-role equivalent of the reference's shared C++ infrastructure
+// (SURVEY.md §2.2): ThriftClient.h / ClientPool.h (framed RPC + pooled
+// clients), tracing.h (carrier inject/extract around every hop), logger.h,
+// utils.h (config load) — redesigned around one binary codec and a span
+// sink that streams to our own collector instead of a Jaeger agent.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+
+namespace sns {
+
+// ---------------------------------------------------------------------------
+// Logging (reference: src/logger.h — console sink, severity >= warning)
+
+enum class LogLevel { Debug = 0, Info = 1, Warning = 2, Error = 3 };
+extern LogLevel g_log_level;
+void LogLine(LogLevel level, const std::string& msg);
+#define SNS_LOG(level, msg)                                           \
+  do {                                                                \
+    if (static_cast<int>(level) >= static_cast<int>(::sns::g_log_level)) \
+      ::sns::LogLine(level, (msg));                                   \
+  } while (0)
+
+uint64_t NowNs();      // CLOCK_REALTIME
+uint64_t MonoNs();     // CLOCK_MONOTONIC
+uint64_t RandomU64();  // thread-local xorshift, seeded from /dev/urandom
+
+// ---------------------------------------------------------------------------
+// Sockets + framed transport
+
+// A connected TCP stream carrying length-prefixed frames
+// (uint32 big-endian length, then payload).
+class FramedSocket {
+ public:
+  explicit FramedSocket(int fd) : fd_(fd) {}
+  ~FramedSocket();
+  FramedSocket(const FramedSocket&) = delete;
+  FramedSocket& operator=(const FramedSocket&) = delete;
+
+  static std::unique_ptr<FramedSocket> Connect(const std::string& host, int port,
+                                               int timeout_ms = 2000);
+  bool WriteFrame(const std::string& payload);
+  // Returns false on EOF/error. Caps frames at 64 MiB.
+  bool ReadFrame(std::string* payload);
+  bool ok() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+ private:
+  bool WriteAll(const char* data, size_t n);
+  bool ReadAll(char* data, size_t n);
+  int fd_;
+};
+
+int ListenOn(int port, int backlog = 512);  // returns listening fd (throws on error)
+
+// poll()+accept with a timeout so accept loops can observe shutdown flags;
+// returns -1 on timeout/error.
+int AcceptWithTimeout(int listen_fd, int timeout_ms);
+
+// ---------------------------------------------------------------------------
+// Tracing (reference: src/tracing.h + per-handler span pattern)
+
+struct TraceContext {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;  // the parent for the next hop
+  bool sampled = true;
+};
+
+struct SpanRecord {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_id = 0;
+  std::string component;
+  std::string operation;
+  uint64_t start_ns = 0;
+  uint64_t end_ns = 0;
+};
+
+// Process-wide sink: finished spans are buffered and a background thread
+// flushes them to the collector as JSON frames. Lossy under collector
+// outage by design (bounded buffer) — telemetry must not back-pressure the
+// app (the reference's Jaeger agent UDP has the same property).
+class SpanSink {
+ public:
+  static SpanSink& Get();
+  void Configure(const std::string& component, const std::string& collector_host,
+                 int collector_port);
+  void Record(SpanRecord span);
+  void Flush();     // synchronous best-effort drain (used at shutdown)
+  void Shutdown();
+  const std::string& component() const { return component_; }
+
+ private:
+  SpanSink() = default;
+  void FlushLoop();
+  bool SendBatch(std::vector<SpanRecord> batch);
+
+  std::mutex mu_;
+  std::vector<SpanRecord> buffer_;
+  std::string component_;
+  std::string host_;
+  int port_ = 0;
+  std::unique_ptr<FramedSocket> conn_;
+  std::thread flusher_;
+  std::atomic<bool> running_{false};
+  static constexpr size_t kMaxBuffered = 1 << 16;
+};
+
+// RAII span: opens on construction, records to the sink on destruction.
+class ScopedSpan {
+ public:
+  ScopedSpan(const TraceContext& parent, const std::string& operation,
+             const std::string& component = "");
+  ~ScopedSpan();
+  const TraceContext& context() const { return ctx_; }  // for child hops
+
+ private:
+  SpanRecord span_;
+  TraceContext ctx_;
+  bool sampled_;
+};
+
+// ---------------------------------------------------------------------------
+// RPC wire format
+//
+// Request frame:  JSON {"m": method, "t": [trace_id, span_id, sampled],
+//                       "a": {args...}}
+// Response frame: JSON {"ok": bool, "e": error-string?, "r": result}
+
+struct RpcRequest {
+  std::string method;
+  TraceContext ctx;
+  Json args;
+};
+
+std::string EncodeRequest(const std::string& method, const TraceContext& ctx,
+                          const Json& args);
+bool DecodeRequest(const std::string& frame, RpcRequest* out);
+std::string EncodeResponse(bool ok, const std::string& error, const Json& result);
+bool DecodeResponse(const std::string& frame, bool* ok, std::string* error,
+                    Json* result);
+
+// ---------------------------------------------------------------------------
+// RPC server: accept loop + one handler thread per connection. Connections
+// are long-lived and serially pipelined (the client pool holds one
+// in-flight call per pooled connection, like the reference's pooled
+// Thrift clients).
+
+using RpcHandler = std::function<Json(const TraceContext&, const Json&)>;
+
+class RpcServer {
+ public:
+  RpcServer(std::string component, int port);
+  void Register(const std::string& method, RpcHandler handler);
+  void Serve();        // blocks
+  void Start();        // serve on a background thread
+  void Stop();
+  int port() const { return port_; }
+
+ private:
+  void HandleConnection(int fd, uint64_t conn_id);
+  std::string component_;
+  int port_;
+  std::atomic<int> listen_fd_{-1};
+  std::map<std::string, RpcHandler> handlers_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  uint64_t next_conn_id_ = 0;
+  std::map<uint64_t, std::thread> conn_threads_;  // id -> handler thread
+  std::map<uint64_t, int> active_fds_;            // id -> fd (for shutdown)
+  std::vector<std::thread> done_threads_;         // finished, pending join
+};
+
+// ---------------------------------------------------------------------------
+// RPC client + pool (reference: ClientPool.h — deque+mutex+condvar, grow to
+// max then block with timeout, evict broken clients)
+
+class RpcClient {
+ public:
+  RpcClient(std::string host, int port) : host_(std::move(host)), port_(port) {}
+  // Throws std::runtime_error on transport or application error.
+  Json Call(const std::string& method, const TraceContext& ctx, const Json& args);
+  bool Connect();
+  bool connected() const { return conn_ && conn_->ok(); }
+
+ private:
+  std::string host_;
+  int port_;
+  std::unique_ptr<FramedSocket> conn_;
+};
+
+class ClientPool {
+ public:
+  ClientPool(std::string host, int port, size_t max_size = 128,
+             int timeout_ms = 1000)
+      : host_(std::move(host)), port_(port), max_size_(max_size),
+        timeout_ms_(timeout_ms) {}
+
+  // Pop-call-push with broken-client eviction; throws on failure.
+  Json Call(const std::string& method, const TraceContext& ctx, const Json& args);
+
+ private:
+  std::unique_ptr<RpcClient> Pop();
+  void Push(std::unique_ptr<RpcClient> c);
+
+  std::string host_;
+  int port_;
+  size_t max_size_;
+  int timeout_ms_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::unique_ptr<RpcClient>> idle_;
+  size_t outstanding_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Cluster config (reference: config/service-config.json — one shared JSON
+// mapping every component to addr:port, plus secrets)
+
+struct Endpoint {
+  std::string host;
+  int port = 0;
+};
+
+class ClusterConfig {
+ public:
+  static ClusterConfig Load(const std::string& path);
+  static ClusterConfig FromJson(const Json& j);
+
+  Endpoint Lookup(const std::string& component) const;  // throws if unknown
+  bool Has(const std::string& component) const { return endpoints_.count(component) > 0; }
+  const std::map<std::string, Endpoint>& endpoints() const { return endpoints_; }
+  const std::string& secret() const { return secret_; }
+  Endpoint collector() const { return Lookup("trace-collector"); }
+
+  // Shared pool registry: one pool per downstream component.
+  ClientPool* PoolFor(const std::string& component);
+
+ private:
+  std::map<std::string, Endpoint> endpoints_;
+  std::string secret_ = "secret";
+  // Heap-held so the config stays movable (factory returns by value).
+  std::unique_ptr<std::mutex> pools_mu_ = std::make_unique<std::mutex>();
+  std::map<std::string, std::unique_ptr<ClientPool>> pools_;
+};
+
+}  // namespace sns

@@ -1,0 +1,407 @@
+#include "gateway.h"
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <future>
+#include <map>
+#include <sstream>
+#include <thread>
+#include <vector>
+
+namespace sns {
+namespace {
+
+Json Obj(std::initializer_list<std::pair<const std::string, Json>> kv) {
+  JsonObject o;
+  for (auto& [k, v] : kv) o[k] = v;
+  return Json(std::move(o));
+}
+
+// ---------------------------------------------------------------------------
+// Minimal HTTP/1.1 plumbing (keep-alive, Content-Length bodies)
+
+struct HttpRequest {
+  std::string method;
+  std::string path;          // without query string
+  std::map<std::string, std::string> params;  // query + urlencoded form
+  std::string body;
+  bool keep_alive = true;
+};
+
+int HexVal(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+std::string UrlDecode(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '+') {
+      out.push_back(' ');
+    } else if (s[i] == '%' && i + 2 < s.size()) {
+      int hi = HexVal(s[i + 1]), lo = HexVal(s[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out.push_back(static_cast<char>(hi * 16 + lo));
+        i += 2;
+      } else {
+        out.push_back('%');
+      }
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+void ParseParams(const std::string& s, std::map<std::string, std::string>* out) {
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t amp = s.find('&', pos);
+    if (amp == std::string::npos) amp = s.size();
+    size_t eq = s.find('=', pos);
+    if (eq != std::string::npos && eq < amp)
+      (*out)[UrlDecode(s.substr(pos, eq - pos))] =
+          UrlDecode(s.substr(eq + 1, amp - eq - 1));
+    pos = amp + 1;
+  }
+}
+
+class HttpConnection {
+ public:
+  explicit HttpConnection(int fd) : fd_(fd) {}
+  ~HttpConnection() { ::close(fd_); }
+
+  bool ReadRequest(HttpRequest* req) {
+    std::string head;
+    if (!ReadUntil("\r\n\r\n", &head)) return false;
+    std::istringstream hs(head);
+    std::string line;
+    if (!std::getline(hs, line)) return false;
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    std::istringstream rl(line);
+    std::string version;
+    rl >> req->method >> req->path >> version;
+    if (req->method.empty() || req->path.empty()) return false;
+    req->keep_alive = version != "HTTP/1.0";
+
+    size_t content_length = 0;
+    std::string content_type;
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (line.empty()) break;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      for (auto& c : key) c = static_cast<char>(tolower(c));
+      std::string value = line.substr(colon + 1);
+      while (!value.empty() && value.front() == ' ') value.erase(value.begin());
+      if (key == "content-length") {
+        // No exceptions here: a malformed header must fail the connection,
+        // not escape the handler thread and terminate the process.
+        char* end = nullptr;
+        unsigned long long n = strtoull(value.c_str(), &end, 10);
+        if (end == value.c_str() || *end != '\0') return false;
+        content_length = static_cast<size_t>(n);
+      } else if (key == "content-type") content_type = value;
+      else if (key == "connection" && value == "close") req->keep_alive = false;
+    }
+
+    size_t q = req->path.find('?');
+    if (q != std::string::npos) {
+      ParseParams(req->path.substr(q + 1), &req->params);
+      req->path.resize(q);
+    }
+    if (content_length > 0) {
+      if (content_length > (64u << 20)) return false;
+      if (!ReadBody(content_length, &req->body)) return false;
+      if (content_type.find("application/x-www-form-urlencoded") !=
+          std::string::npos)
+        ParseParams(req->body, &req->params);
+    }
+    return true;
+  }
+
+  bool WriteResponse(int status, const std::string& body, bool keep_alive,
+                     const char* content_type = "application/json") {
+    static const std::map<int, const char*> kReasons = {
+        {200, "OK"}, {400, "Bad Request"}, {404, "Not Found"},
+        {500, "Internal Server Error"}};
+    auto it = kReasons.find(status);
+    std::ostringstream out;
+    out << "HTTP/1.1 " << status << " "
+        << (it == kReasons.end() ? "Unknown" : it->second) << "\r\n"
+        << "Content-Type: " << content_type << "\r\n"
+        << "Content-Length: " << body.size() << "\r\n"
+        << "Connection: " << (keep_alive ? "keep-alive" : "close") << "\r\n\r\n"
+        << body;
+    std::string data = out.str();
+    return WriteAll(data.data(), data.size());
+  }
+
+ private:
+  bool ReadUntil(const char* delim, std::string* out) {
+    size_t dlen = strlen(delim);
+    while (true) {
+      size_t hit = buffer_.find(delim);
+      if (hit != std::string::npos) {
+        *out = buffer_.substr(0, hit + dlen);
+        buffer_.erase(0, hit + dlen);
+        return true;
+      }
+      if (buffer_.size() > (1u << 20)) return false;
+      char chunk[4096];
+      ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
+      if (r <= 0) {
+        if (r < 0 && errno == EINTR) continue;
+        return false;
+      }
+      buffer_.append(chunk, static_cast<size_t>(r));
+    }
+  }
+
+  bool ReadBody(size_t n, std::string* out) {
+    while (buffer_.size() < n) {
+      char chunk[8192];
+      ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
+      if (r <= 0) {
+        if (r < 0 && errno == EINTR) continue;
+        return false;
+      }
+      buffer_.append(chunk, static_cast<size_t>(r));
+    }
+    *out = buffer_.substr(0, n);
+    buffer_.erase(0, n);
+    return true;
+  }
+
+  bool WriteAll(const char* data, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::send(fd_, data, n, MSG_NOSIGNAL);
+      if (w <= 0) {
+        if (w < 0 && errno == EINTR) continue;
+        return false;
+      }
+      data += w;
+      n -= static_cast<size_t>(w);
+    }
+    return true;
+  }
+
+  int fd_;
+  std::string buffer_;
+};
+
+// ---------------------------------------------------------------------------
+// Route handlers
+
+std::string Param(const HttpRequest& req, const std::string& key,
+                  const std::string& dflt = "") {
+  auto it = req.params.find(key);
+  return it == req.params.end() ? dflt : it->second;
+}
+
+int64_t IntParam(const HttpRequest& req, const std::string& key, int64_t dflt) {
+  auto it = req.params.find(key);
+  if (it == req.params.end()) return dflt;
+  char* end = nullptr;
+  long long v = strtoll(it->second.c_str(), &end, 10);
+  return end == it->second.c_str() ? dflt : v;
+}
+
+// The REST gateway: every route mirrors a reference wrk2-api endpoint
+// (nginx.conf:82-339); compose fans out 3-way in parallel then triggers the
+// unique-id upload, exactly the gateway Lua's thread.spawn structure
+// (compose.lua:111-130).
+Json HandleApi(const HttpRequest& req, const TraceContext& ctx,
+               ClusterConfig* cfg) {
+  if (req.path == "/wrk2-api/user/register") {
+    cfg->PoolFor("user-service")
+        ->Call("RegisterUserWithId", ctx,
+               Obj({{"user_id", Json(IntParam(req, "user_id", 0))},
+                    {"username", Json(Param(req, "username"))},
+                    {"password", Json(Param(req, "password"))}}));
+    return Json("ok");
+  }
+  if (req.path == "/wrk2-api/user/follow") {
+    cfg->PoolFor("social-graph-service")
+        ->Call("Follow", ctx,
+               Obj({{"user_id", Json(IntParam(req, "user_id", 0))},
+                    {"followee_id", Json(IntParam(req, "followee_id", 0))}}));
+    return Json("ok");
+  }
+  if (req.path == "/wrk2-api/user/unfollow") {
+    cfg->PoolFor("social-graph-service")
+        ->Call("Unfollow", ctx,
+               Obj({{"user_id", Json(IntParam(req, "user_id", 0))},
+                    {"followee_id", Json(IntParam(req, "followee_id", 0))}}));
+    return Json("ok");
+  }
+  if (req.path == "/wrk2-api/user/login") {
+    return cfg->PoolFor("user-service")
+        ->Call("Login", ctx,
+               Obj({{"username", Json(Param(req, "username"))},
+                    {"password", Json(Param(req, "password"))}}));
+  }
+  if (req.path == "/wrk2-api/post/compose") {
+    std::string req_id = std::to_string(RandomU64());
+    int64_t user_id = IntParam(req, "user_id", 0);
+    auto f_creator = std::async(std::launch::async, [&, ctx] {
+      cfg->PoolFor("user-service")
+          ->Call("UploadCreatorWithUserId", ctx,
+                 Obj({{"req_id", Json(req_id)}, {"user_id", Json(user_id)},
+                      {"username", Json(Param(req, "username"))}}));
+    });
+    auto f_media = std::async(std::launch::async, [&, ctx] {
+      Json args = Obj({{"req_id", Json(req_id)}});
+      std::string media_id = Param(req, "media_id");
+      if (!media_id.empty()) {
+        args.set("media_id", Json(media_id));
+        args.set("media_type", Json(Param(req, "media_type", "jpg")));
+      }
+      cfg->PoolFor("media-service")->Call("UploadMedia", ctx, args);
+    });
+    auto f_text = std::async(std::launch::async, [&, ctx] {
+      cfg->PoolFor("text-service")
+          ->Call("UploadText", ctx,
+                 Obj({{"req_id", Json(req_id)},
+                      {"text", Json(Param(req, "text"))}}));
+    });
+    f_creator.get();
+    f_media.get();
+    f_text.get();
+    Json post_id = cfg->PoolFor("unique-id-service")
+                       ->Call("UploadUniqueId", ctx,
+                              Obj({{"req_id", Json(req_id)},
+                                   {"post_type", Json(0)}}));
+    return Obj({{"post_id", post_id}});
+  }
+  if (req.path == "/wrk2-api/home-timeline/read") {
+    return cfg->PoolFor("home-timeline-service")
+        ->Call("ReadHomeTimeline", ctx,
+               Obj({{"user_id", Json(IntParam(req, "user_id", 0))},
+                    {"start", Json(IntParam(req, "start", 0))},
+                    {"stop", Json(IntParam(req, "stop", 9))}}));
+  }
+  if (req.path == "/wrk2-api/user-timeline/read") {
+    return cfg->PoolFor("user-timeline-service")
+        ->Call("ReadUserTimeline", ctx,
+               Obj({{"user_id", Json(IntParam(req, "user_id", 0))},
+                    {"start", Json(IntParam(req, "start", 0))},
+                    {"stop", Json(IntParam(req, "stop", 9))}}));
+  }
+  throw std::runtime_error("404");
+}
+
+// The media frontend: streams upload bodies straight into media-mongodb
+// under its own root span (reference: upload-media.lua:14-86).
+Json HandleMedia(const HttpRequest& req, const TraceContext& ctx,
+                 ClusterConfig* cfg) {
+  if (req.path == "/upload-media") {
+    std::string media_id = std::to_string(RandomU64());
+    cfg->PoolFor("media-mongodb")
+        ->Call("insert", ctx,
+               Obj({{"coll", Json("media")},
+                    {"doc", Obj({{"media_id", Json(media_id)},
+                                 {"media_type", Json(Param(req, "media_type", "jpg"))},
+                                 {"size", Json(static_cast<uint64_t>(req.body.size()))}})}}));
+    return Obj({{"media_id", Json(media_id)},
+                {"media_type", Json(Param(req, "media_type", "jpg"))}});
+  }
+  if (req.path == "/get-media") {
+    return cfg->PoolFor("media-mongodb")
+        ->Call("findone", ctx,
+               Obj({{"coll", Json("media")}, {"field", Json("media_id")},
+                    {"value", Json(Param(req, "media_id"))}}));
+  }
+  throw std::runtime_error("404");
+}
+
+}  // namespace
+
+void RunGateway(const std::string& role, int port, ClusterConfig* cfg,
+                const std::atomic<bool>* running) {
+  bool is_media = role == "media-frontend";
+  int listen_fd = ListenOn(port);
+  SNS_LOG(LogLevel::Info, role + " http on :" + std::to_string(port));
+
+  auto handle = [=](int fd) {
+    HttpConnection conn(fd);
+    HttpRequest req;
+    while ((running == nullptr || running->load()) && conn.ReadRequest(&req)) {
+      // /healthz serves readiness probes without touching the trace plane.
+      if (req.path == "/healthz") {
+        if (!conn.WriteResponse(200, "ok", req.keep_alive, "text/plain")) break;
+        req = HttpRequest();
+        continue;
+      }
+      int status = 200;
+      std::string body;
+      try {
+        // Root span of the whole trace (reference: the nginx-opentracing
+        // bridge span the Lua scripts attach to).
+        ScopedSpan root(TraceContext{}, req.path, role);
+        Json result = is_media ? HandleMedia(req, root.context(), cfg)
+                               : HandleApi(req, root.context(), cfg);
+        body = result.dump();
+      } catch (const std::exception& e) {
+        if (std::string(e.what()) == "404") {
+          status = 404;
+          body = "{\"error\":\"no such endpoint\"}";
+        } else {
+          status = 500;
+          body = std::string("{\"error\":") + Json(e.what()).dump() + "}";
+        }
+      }
+      if (!conn.WriteResponse(status, body, req.keep_alive)) break;
+      if (!req.keep_alive) break;
+      req = HttpRequest();
+    }
+  };
+
+  std::mutex mu;
+  uint64_t next_id = 0;
+  std::map<uint64_t, std::thread> conns;
+  std::map<uint64_t, int> fds;
+  std::vector<std::thread> done;
+  while (running == nullptr || running->load()) {
+    int fd = AcceptWithTimeout(listen_fd, 200);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::lock_guard<std::mutex> lock(mu);
+    uint64_t id = next_id++;
+    fds[id] = fd;
+    conns.emplace(id, std::thread([&, fd, id] {
+      handle(fd);
+      std::lock_guard<std::mutex> l(mu);
+      fds.erase(id);
+      auto it = conns.find(id);
+      if (it != conns.end()) {
+        done.push_back(std::move(it->second));
+        conns.erase(it);
+      }
+    }));
+    for (auto& t : done) t.join();
+    done.clear();
+  }
+  ::close(listen_fd);
+  std::map<uint64_t, std::thread> leftover;
+  std::vector<std::thread> leftover_done;
+  {
+    std::lock_guard<std::mutex> lock(mu);
+    for (auto& [id, fd] : fds) ::shutdown(fd, SHUT_RDWR);
+    leftover.swap(conns);
+    leftover_done.swap(done);
+  }
+  for (auto& [id, t] : leftover) t.join();
+  for (auto& t : leftover_done) t.join();
+}
+
+}  // namespace sns

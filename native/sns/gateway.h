@@ -1,0 +1,21 @@
+// HTTP front doors: the REST gateway ("nginx-thrift") and the media
+// frontend — equivalents of the reference's two OpenResty/Lua gateways
+// (SURVEY.md §L1 public interface; routes from nginx.conf:82-339 and
+// media-frontend/lua-scripts-k8s/upload-media.lua). Each request opens the
+// root span of its trace, exactly like the nginx-opentracing bridge does in
+// the reference (compose.lua:92-98).
+#pragma once
+
+#include <atomic>
+#include <string>
+
+#include "common.h"
+
+namespace sns {
+
+// Runs the HTTP server for `role` ("nginx-thrift" or "media-frontend") on
+// `port`. Blocks until `running` (if given) goes false.
+void RunGateway(const std::string& role, int port, ClusterConfig* config,
+                const std::atomic<bool>* running = nullptr);
+
+}  // namespace sns

@@ -1,0 +1,346 @@
+// Minimal JSON value type: parse / serialize, no external deps.
+//
+// The app plane uses JSON in three places: the cluster config file
+// (equivalent of the reference's shared service-config.json,
+// social-network-source/config/service-config.json), RPC argument bodies
+// (the reference uses Thrift binary; we frame binary headers and carry a
+// JSON body — same role, one codec), and the collector's raw-bucket JSONL
+// output consumed by deeprest_tpu.data.schema.
+#pragma once
+
+#include <cerrno>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace sns {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps key order deterministic, which keeps collector output diffable.
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  // Int is kept distinct from Number so 64-bit ids (span/trace/post ids)
+  // survive transport exactly — a double mantissa would silently round
+  // anything above 2^53.
+  enum class Type { Null, Bool, Int, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(double n) : type_(Type::Number), num_(n) {}
+  Json(int n) : type_(Type::Int), int_(n) {}
+  Json(int64_t n) : type_(Type::Int), int_(n) {}
+  Json(uint64_t n) : type_(Type::Int), int_(static_cast<int64_t>(n)) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number || type_ == Type::Int; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_double(double dflt = 0.0) const {
+    if (type_ == Type::Number) return num_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Number) return static_cast<int64_t>(num_);
+    return dflt;
+  }
+  uint64_t as_uint(uint64_t dflt = 0) const {
+    if (type_ == Type::Int) return static_cast<uint64_t>(int_);
+    if (type_ == Type::Number) return static_cast<uint64_t>(num_);
+    return dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string kEmpty;
+    return type_ == Type::String ? str_ : kEmpty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray kEmpty;
+    return type_ == Type::Array ? arr_ : kEmpty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject kEmpty;
+    return type_ == Type::Object ? obj_ : kEmpty;
+  }
+  JsonArray& mutable_array() {
+    if (type_ != Type::Array) { type_ = Type::Array; arr_.clear(); }
+    return arr_;
+  }
+  JsonObject& mutable_object() {
+    if (type_ != Type::Object) { type_ = Type::Object; obj_.clear(); }
+    return obj_;
+  }
+
+  // Object lookup; returns a Null singleton for missing keys.
+  const Json& operator[](const std::string& key) const {
+    static const Json kNull;
+    if (type_ != Type::Object) return kNull;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? kNull : it->second;
+  }
+  Json& set(const std::string& key, Json v) {
+    mutable_object()[key] = std::move(v);
+    return *this;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+
+  // -- serialization -------------------------------------------------------
+  void dump(std::string* out) const {
+    switch (type_) {
+      case Type::Null: out->append("null"); break;
+      case Type::Bool: out->append(bool_ ? "true" : "false"); break;
+      case Type::Int: {
+        char buf[24];
+        snprintf(buf, sizeof buf, "%lld", static_cast<long long>(int_));
+        out->append(buf);
+        break;
+      }
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.007199254740992e15) {
+          char buf[32];
+          snprintf(buf, sizeof buf, "%lld", static_cast<long long>(num_));
+          out->append(buf);
+        } else {
+          char buf[32];
+          snprintf(buf, sizeof buf, "%.17g", num_);
+          out->append(buf);
+        }
+        break;
+      }
+      case Type::String: dump_string(str_, out); break;
+      case Type::Array: {
+        out->push_back('[');
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out->push_back(',');
+          arr_[i].dump(out);
+        }
+        out->push_back(']');
+        break;
+      }
+      case Type::Object: {
+        out->push_back('{');
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) out->push_back(',');
+          first = false;
+          dump_string(k, out);
+          out->push_back(':');
+          v.dump(out);
+        }
+        out->push_back('}');
+        break;
+      }
+    }
+  }
+  std::string dump() const {
+    std::string out;
+    dump(&out);
+    return out;
+  }
+
+  // -- parsing -------------------------------------------------------------
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, &pos);
+    skip_ws(text, &pos);
+    if (pos != text.size())
+      throw std::runtime_error("json: trailing characters at " + std::to_string(pos));
+    return v;
+  }
+
+ private:
+  static void dump_string(const std::string& s, std::string* out) {
+    out->push_back('"');
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out->append("\\\""); break;
+        case '\\': out->append("\\\\"); break;
+        case '\n': out->append("\\n"); break;
+        case '\r': out->append("\\r"); break;
+        case '\t': out->append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out->append(buf);
+          } else {
+            out->push_back(static_cast<char>(c));
+          }
+      }
+    }
+    out->push_back('"');
+  }
+
+  static void skip_ws(const std::string& s, size_t* pos) {
+    while (*pos < s.size() &&
+           (s[*pos] == ' ' || s[*pos] == '\t' || s[*pos] == '\n' || s[*pos] == '\r'))
+      ++*pos;
+  }
+
+  static Json parse_value(const std::string& s, size_t* pos) {
+    skip_ws(s, pos);
+    if (*pos >= s.size()) throw std::runtime_error("json: unexpected end");
+    char c = s[*pos];
+    switch (c) {
+      case '{': return parse_object(s, pos);
+      case '[': return parse_array(s, pos);
+      case '"': return Json(parse_string(s, pos));
+      case 't': expect(s, pos, "true"); return Json(true);
+      case 'f': expect(s, pos, "false"); return Json(false);
+      case 'n': expect(s, pos, "null"); return Json();
+      default: return parse_number(s, pos);
+    }
+  }
+
+  static void expect(const std::string& s, size_t* pos, const char* lit) {
+    size_t n = strlen(lit);
+    if (s.compare(*pos, n, lit) != 0)
+      throw std::runtime_error("json: bad literal at " + std::to_string(*pos));
+    *pos += n;
+  }
+
+  static Json parse_number(const std::string& s, size_t* pos) {
+    const char* start = s.c_str() + *pos;
+    // Integral fast path: keeps 64-bit ids exact (doubles round past 2^53).
+    const char* p = start;
+    if (*p == '-') ++p;
+    const char* digits_begin = p;
+    while (*p >= '0' && *p <= '9') ++p;
+    bool integral = p != digits_begin && *p != '.' && *p != 'e' && *p != 'E';
+    if (integral && (p - digits_begin) <= 19) {  // ERANGE falls through
+      errno = 0;
+      char* end = nullptr;
+      long long v = strtoll(start, &end, 10);
+      if (end != start && errno == 0) {
+        *pos += static_cast<size_t>(end - start);
+        return Json(static_cast<int64_t>(v));
+      }
+    }
+    // strtod parses in place (no tail copy — frames can be tens of MB).
+    char* end = nullptr;
+    double v = strtod(start, &end);
+    if (end == start)
+      throw std::runtime_error("json: bad number at " + std::to_string(*pos));
+    *pos += static_cast<size_t>(end - start);
+    return Json(v);
+  }
+
+  static std::string parse_string(const std::string& s, size_t* pos) {
+    ++*pos;  // opening quote
+    std::string out;
+    while (*pos < s.size()) {
+      char c = s[(*pos)++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (*pos >= s.size()) break;
+        char e = s[(*pos)++];
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            if (*pos + 4 > s.size()) throw std::runtime_error("json: bad \\u");
+            unsigned code = static_cast<unsigned>(
+                std::stoul(s.substr(*pos, 4), nullptr, 16));
+            *pos += 4;
+            // UTF-8 encode (surrogate pairs folded to replacement char —
+            // trace payloads are ASCII service/operation names).
+            if (code < 0x80) {
+              out.push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out.push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out.push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out.push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out.push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: throw std::runtime_error("json: bad escape");
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+    throw std::runtime_error("json: unterminated string");
+  }
+
+  static Json parse_array(const std::string& s, size_t* pos) {
+    ++*pos;  // '['
+    JsonArray arr;
+    skip_ws(s, pos);
+    if (*pos < s.size() && s[*pos] == ']') { ++*pos; return Json(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value(s, pos));
+      skip_ws(s, pos);
+      if (*pos >= s.size()) throw std::runtime_error("json: unterminated array");
+      if (s[*pos] == ',') { ++*pos; continue; }
+      if (s[*pos] == ']') { ++*pos; return Json(std::move(arr)); }
+      throw std::runtime_error("json: bad array at " + std::to_string(*pos));
+    }
+  }
+
+  static Json parse_object(const std::string& s, size_t* pos) {
+    ++*pos;  // '{'
+    JsonObject obj;
+    skip_ws(s, pos);
+    if (*pos < s.size() && s[*pos] == '}') { ++*pos; return Json(std::move(obj)); }
+    while (true) {
+      skip_ws(s, pos);
+      if (*pos >= s.size() || s[*pos] != '"')
+        throw std::runtime_error("json: expected key at " + std::to_string(*pos));
+      std::string key = parse_string(s, pos);
+      skip_ws(s, pos);
+      if (*pos >= s.size() || s[*pos] != ':')
+        throw std::runtime_error("json: expected ':' at " + std::to_string(*pos));
+      ++*pos;
+      obj[std::move(key)] = parse_value(s, pos);
+      skip_ws(s, pos);
+      if (*pos >= s.size()) throw std::runtime_error("json: unterminated object");
+      if (s[*pos] == ',') { ++*pos; continue; }
+      if (s[*pos] == '}') { ++*pos; return Json(std::move(obj)); }
+      throw std::runtime_error("json: bad object at " + std::to_string(*pos));
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double num_ = 0.0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace sns

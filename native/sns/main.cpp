@@ -1,0 +1,324 @@
+// snsd — the app-plane binary. One executable, every role: embedded
+// datastores, the twelve application services, the two HTTP gateways, the
+// home-timeline queue consumer, and the trace collector/ETL. Role dispatch
+// by component name mirrors the reference's one-main-per-service layout
+// (SURVEY.md §2.2 server skeleton) without duplicating twelve mains.
+//
+//   snsd --service=user-service --config=cluster.json
+//   snsd --service=trace-collector --config=cluster.json --out=raw.jsonl
+//   snsd --selftest           # in-process mini-cluster smoke test
+
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "collector.h"
+#include "common.h"
+#include "gateway.h"
+#include "services.h"
+#include "store.h"
+
+namespace sns {
+namespace {
+
+std::atomic<bool> g_running{true};
+
+void OnSignal(int) { g_running = false; }
+
+std::string ArgValue(int argc, char** argv, const std::string& flag,
+                     const std::string& dflt = "") {
+  std::string prefix = "--" + flag + "=";
+  for (int i = 1; i < argc; ++i)
+    if (strncmp(argv[i], prefix.c_str(), prefix.size()) == 0)
+      return argv[i] + prefix.size();
+  return dflt;
+}
+
+bool HasFlag(int argc, char** argv, const std::string& flag) {
+  std::string f = "--" + flag;
+  for (int i = 1; i < argc; ++i)
+    if (f == argv[i]) return true;
+  return false;
+}
+
+void RegisterWithCollector(const ClusterConfig& cfg, const std::string& component) {
+  if (component == "trace-collector" || !cfg.Has("trace-collector")) return;
+  Endpoint ep = cfg.Lookup("trace-collector");
+  // Best-effort: the collector may come up after us; the supervisor starts
+  // it first, but registration loss only costs metrics, never correctness.
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    auto sock = FramedSocket::Connect(ep.host, ep.port, 500);
+    if (sock) {
+      Json reg;
+      reg.set("register", Json(component)).set("pid", Json(int64_t{getpid()}));
+      sock->WriteFrame(reg.dump());
+      return;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  }
+  SNS_LOG(LogLevel::Warning, "could not register with collector");
+}
+
+int RunRole(const std::string& component, ClusterConfig& cfg, int argc,
+            char** argv) {
+  // Consumer roles bind no port; look up lazily for the server roles.
+  Endpoint self;
+  if (cfg.Has(component)) self = cfg.Lookup(component);
+  if (component != "trace-collector" && cfg.Has("trace-collector")) {
+    Endpoint coll = cfg.Lookup("trace-collector");
+    SpanSink::Get().Configure(component, coll.host, coll.port);
+  }
+  RegisterWithCollector(cfg, component);
+
+  // Serve until SIGTERM/SIGINT, then stop cleanly so the span sink drains
+  // (reference services install SIGINT handlers for the same reason,
+  // UserTimelineService.cpp:32-34).
+  auto serve_until_signal = [&](RpcServer& server) {
+    server.Start();
+    while (g_running)
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    server.Stop();
+  };
+
+  std::string kind = StoreKindFor(component);
+  if (kind == "kv") {
+    KvEngine engine;
+    RpcServer server(component, self.port);
+    RegisterKvService(&server, &engine);
+    serve_until_signal(server);
+  } else if (kind == "doc") {
+    DocEngine engine;
+    RpcServer server(component, self.port);
+    RegisterDocService(&server, &engine);
+    serve_until_signal(server);
+  } else if (kind == "cache") {
+    CacheEngine engine;
+    RpcServer server(component, self.port);
+    RegisterCacheService(&server, &engine);
+    serve_until_signal(server);
+  } else if (kind == "queue") {
+    QueueEngine engine;
+    RpcServer server(component, self.port);
+    RegisterQueueService(&server, &engine);
+    serve_until_signal(server);
+  } else if (component == "nginx-thrift" || component == "media-frontend") {
+    RunGateway(component, self.port, &cfg, &g_running);
+  } else if (component == "write-home-timeline-service") {
+    RunHomeTimelineWriter(&cfg, 4, &g_running);
+  } else if (component == "trace-collector") {
+    CollectorOptions opts;
+    opts.port = self.port;
+    opts.interval_ms = std::stoi(ArgValue(argc, argv, "interval-ms", "5000"));
+    opts.grace_ms = std::stoi(ArgValue(argc, argv, "grace-ms", "1000"));
+    opts.output_path = ArgValue(argc, argv, "out", "raw_data.jsonl");
+    Collector collector(&cfg, opts);
+    collector.Run(g_running);
+  } else if (IsAppService(component)) {
+    RpcServer server(component, self.port);
+    RegisterAppService(component, &server, &cfg);
+    serve_until_signal(server);
+  } else {
+    std::cerr << "unknown role: " << component << "\n";
+    return 2;
+  }
+  SpanSink::Get().Shutdown();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// --selftest: the full cluster in one process on loopback ports. Proves the
+// wire protocol, the saga, tracing, and the collector end-to-end without a
+// supervisor; CI runs this under TSan.
+
+int SelfTest() {
+  int base = 21000 + static_cast<int>(RandomU64() % 2000);
+  const char* stores[] = {"compose-post-redis", "user-timeline-redis",
+                          "home-timeline-redis", "social-graph-redis",
+                          "user-mongodb", "post-storage-mongodb",
+                          "user-timeline-mongodb", "social-graph-mongodb",
+                          "url-shorten-mongodb", "media-mongodb",
+                          "user-memcached", "post-storage-memcached",
+                          "rabbitmq"};
+  const char* services[] = {"compose-post-service", "unique-id-service",
+                            "text-service", "url-shorten-service",
+                            "user-mention-service", "media-service",
+                            "user-service", "social-graph-service",
+                            "post-storage-service", "user-timeline-service",
+                            "home-timeline-service"};
+  Json comps;
+  int port = base;
+  for (const char* c : stores) comps.set(c, Json().set("host", Json("127.0.0.1")).set("port", Json(port++)));
+  for (const char* c : services) comps.set(c, Json().set("host", Json("127.0.0.1")).set("port", Json(port++)));
+  comps.set("nginx-thrift", Json().set("host", Json("127.0.0.1")).set("port", Json(port++)));
+  comps.set("media-frontend", Json().set("host", Json("127.0.0.1")).set("port", Json(port++)));
+  comps.set("trace-collector", Json().set("host", Json("127.0.0.1")).set("port", Json(port++)));
+  ClusterConfig cfg = ClusterConfig::FromJson(Json().set("components", comps));
+
+  SpanSink::Get().Configure("selftest", "127.0.0.1",
+                            cfg.Lookup("trace-collector").port);
+
+  // Engines + servers (kept alive for the whole test).
+  std::vector<std::unique_ptr<RpcServer>> servers;
+  std::vector<std::unique_ptr<KvEngine>> kvs;
+  std::vector<std::unique_ptr<DocEngine>> docs;
+  std::vector<std::unique_ptr<CacheEngine>> caches;
+  auto queue = std::make_unique<QueueEngine>();
+  for (const char* c : stores) {
+    auto server = std::make_unique<RpcServer>(c, cfg.Lookup(c).port);
+    std::string kind = StoreKindFor(c);
+    if (kind == "kv") {
+      kvs.push_back(std::make_unique<KvEngine>());
+      RegisterKvService(server.get(), kvs.back().get());
+    } else if (kind == "doc") {
+      docs.push_back(std::make_unique<DocEngine>());
+      RegisterDocService(server.get(), docs.back().get());
+    } else if (kind == "cache") {
+      caches.push_back(std::make_unique<CacheEngine>());
+      RegisterCacheService(server.get(), caches.back().get());
+    } else {
+      RegisterQueueService(server.get(), queue.get());
+    }
+    server->Start();
+    servers.push_back(std::move(server));
+  }
+  for (const char* c : services) {
+    auto server = std::make_unique<RpcServer>(c, cfg.Lookup(c).port);
+    RegisterAppService(c, server.get(), &cfg);
+    server->Start();
+    servers.push_back(std::move(server));
+  }
+  std::atomic<bool> running{true};
+  std::thread writer([&] { RunHomeTimelineWriter(&cfg, 2, &running); });
+  std::thread gateway([&] {
+    RunGateway("nginx-thrift", cfg.Lookup("nginx-thrift").port, &cfg, &running);
+  });
+  CollectorOptions copts;
+  copts.port = cfg.Lookup("trace-collector").port;
+  copts.interval_ms = 400;
+  copts.grace_ms = 400;
+  copts.output_path = "/tmp/sns_selftest_raw.jsonl";
+  std::remove(copts.output_path.c_str());
+  Collector collector(&cfg, copts);
+  // Everything shares one process here; register it under each service name
+  // so the metric sampling path is exercised (process-per-role supervision
+  // registers real pids).
+  for (const char* c : services) collector.RegisterProcess(c, getpid());
+  collector.RegisterProcess("nginx-thrift", getpid());
+  std::thread coll([&] { collector.Run(running); });
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+
+  // Drive the API through the gateway like the load generator would.
+  auto http = [&](const std::string& method, const std::string& path,
+                  const std::string& body) {
+    auto sock = FramedSocket::Connect("127.0.0.1", cfg.Lookup("nginx-thrift").port);
+    if (!sock) throw std::runtime_error("gateway connect failed");
+    std::string req = method + " " + path + " HTTP/1.1\r\nHost: x\r\n" +
+                      "Content-Type: application/x-www-form-urlencoded\r\n" +
+                      "Content-Length: " + std::to_string(body.size()) +
+                      "\r\nConnection: close\r\n\r\n" + body;
+    if (::send(sock->fd(), req.data(), req.size(), MSG_NOSIGNAL) !=
+        static_cast<ssize_t>(req.size()))
+      throw std::runtime_error("http send failed");
+    std::string resp;
+    char chunk[4096];
+    ssize_t r;
+    while ((r = ::recv(sock->fd(), chunk, sizeof chunk, 0)) > 0)
+      resp.append(chunk, static_cast<size_t>(r));
+    if (resp.find("200") == std::string::npos)
+      throw std::runtime_error("http error: " + resp.substr(0, 200));
+    return resp;
+  };
+
+  int failures = 0;
+  auto check = [&](bool ok, const std::string& what) {
+    if (!ok) {
+      ++failures;
+      std::cerr << "FAIL: " << what << "\n";
+    }
+  };
+
+  try {
+    http("POST", "/wrk2-api/user/register",
+         "user_id=1&username=alice&password=pw1");
+    http("POST", "/wrk2-api/user/register",
+         "user_id=2&username=bob&password=pw2");
+    http("POST", "/wrk2-api/user/follow", "user_id=2&followee_id=1");
+    http("POST", "/wrk2-api/user/login", "username=alice&password=pw1");
+    http("POST", "/wrk2-api/post/compose",
+         "user_id=1&username=alice&text=hello+%40bob+check+https%3A%2F%2Fx.test%2Fy");
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+    std::string home = http("GET", "/wrk2-api/home-timeline/read?user_id=2", "");
+    check(home.find("hello") != std::string::npos,
+          "bob's home timeline contains alice's post");
+    std::string ut = http("GET", "/wrk2-api/user-timeline/read?user_id=1", "");
+    check(ut.find("hello") != std::string::npos,
+          "alice's user timeline contains the post");
+    check(ut.find("short.url") != std::string::npos,
+          "post text carries a shortened url");
+  } catch (const std::exception& e) {
+    ++failures;
+    std::cerr << "FAIL: " << e.what() << "\n";
+  }
+
+  // Let spans flush and buckets cut, then stop everything.
+  std::this_thread::sleep_for(std::chrono::milliseconds(1500));
+  SpanSink::Get().Flush();
+  std::this_thread::sleep_for(std::chrono::milliseconds(900));
+  running = false;
+  gateway.join();
+  for (auto& s : servers) s->Stop();
+  writer.join();
+  coll.join();
+
+  // The collector output must contain a compose trace rooted at the gateway.
+  std::ifstream raw(copts.output_path);
+  std::string all((std::istreambuf_iterator<char>(raw)),
+                  std::istreambuf_iterator<char>());
+  check(all.find("/wrk2-api/post/compose") != std::string::npos,
+        "collector captured the compose root span");
+  check(all.find("compose-post-service") != std::string::npos,
+        "compose-post-service spans present");
+  check(all.find("write-home-timeline-service") != std::string::npos,
+        "async consumer span joined the compose trace");
+  check(all.find("\"resource\":\"cpu\"") != std::string::npos,
+        "cpu metrics sampled");
+
+  std::cout << (failures == 0 ? "selftest OK" : "selftest FAILED") << "\n";
+  return failures == 0 ? 0 : 1;
+}
+
+}  // namespace
+}  // namespace sns
+
+int main(int argc, char** argv) {
+  signal(SIGINT, sns::OnSignal);
+  signal(SIGTERM, sns::OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+  if (sns::HasFlag(argc, argv, "verbose")) sns::g_log_level = sns::LogLevel::Info;
+  if (sns::HasFlag(argc, argv, "selftest")) return sns::SelfTest();
+
+  std::string component = sns::ArgValue(argc, argv, "service");
+  std::string config_path = sns::ArgValue(argc, argv, "config");
+  if (component.empty() || config_path.empty()) {
+    std::cerr << "usage: snsd --service=<component> --config=<cluster.json>\n"
+              << "       snsd --selftest\n";
+    return 2;
+  }
+  try {
+    sns::ClusterConfig cfg = sns::ClusterConfig::Load(config_path);
+    return sns::RunRole(component, cfg, argc, argv);
+  } catch (const std::exception& e) {
+    std::cerr << "fatal: " << e.what() << "\n";
+    return 1;
+  }
+}

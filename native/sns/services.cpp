@@ -1,0 +1,646 @@
+#include "services.h"
+
+#include <unistd.h>
+
+#include <future>
+#include <regex>
+#include <set>
+#include <stdexcept>
+
+#include "sha256.h"
+
+namespace sns {
+namespace {
+
+constexpr int kNumComposeComponents = 6;  // creator,text,media,id,urls,mentions
+constexpr const char* kHomeTimelineQueue = "write-home-timeline";
+
+Json Obj(std::initializer_list<std::pair<const std::string, Json>> kv) {
+  JsonObject o;
+  for (auto& [k, v] : kv) o[k] = v;
+  return Json(std::move(o));
+}
+
+// Unsampled context for broker publish/consume frames: the broker hop emits
+// no span of its own (the reference's AMQP broker is invisible to Jaeger
+// too); the app context rides inside the message payload instead.
+TraceContext Unsampled() {
+  TraceContext c;
+  c.sampled = false;
+  return c;
+}
+
+uint64_t MachineId() {
+  char host[256] = {0};
+  gethostname(host, sizeof host - 1);
+  std::string key = std::string(host) + ":" + std::to_string(getpid());
+  return std::stoull(Sha256::HexDigest(key).substr(0, 8), nullptr, 16);
+}
+
+std::string RandomShortUrl() {
+  static const char* kAlpha =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789";
+  std::string s(10, '0');
+  for (char& c : s) c = kAlpha[RandomU64() % 62];
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// compose-post-service: the saga aggregator (reference behavior:
+// ComposePostHandler.h:104-583 — six fragment uploads accumulate in the
+// redis hash keyed by req_id; the sixth triggers compose + 3-way fan-out).
+
+void RegisterComposePost(RpcServer* server, ClusterConfig* cfg) {
+  auto* redis = cfg->PoolFor("compose-post-redis");
+  auto* post_storage = cfg->PoolFor("post-storage-service");
+  auto* user_timeline = cfg->PoolFor("user-timeline-service");
+  auto* mq = cfg->PoolFor("rabbitmq");
+
+  auto compose_and_upload = [=](const TraceContext& ctx, const std::string& req_id) {
+    Json frags = redis->Call("hgetall", ctx, Obj({{"key", req_id}}));
+    Json post;
+    post.set("post_id", Json::parse(frags["unique_id"].as_string()))
+        .set("creator", Json::parse(frags["creator"].as_string()))
+        .set("text", Json::parse(frags["text"].as_string()))
+        .set("media", Json::parse(frags["media"].as_string()))
+        .set("urls", Json::parse(frags["urls"].as_string()))
+        .set("user_mentions", Json::parse(frags["user_mentions"].as_string()))
+        .set("timestamp", Json(static_cast<int64_t>(NowNs() / 1000000)));
+    uint64_t post_id = post["post_id"].as_uint();
+    int64_t creator_id = post["creator"]["user_id"].as_int();
+
+    // 3-way parallel upload (reference: 3 std::threads,
+    // ComposePostHandler.h:569-583).
+    auto f_store = std::async(std::launch::async, [&, ctx] {
+      post_storage->Call("StorePost", ctx, Obj({{"post", post}}));
+    });
+    auto f_timeline = std::async(std::launch::async, [&, ctx] {
+      user_timeline->Call(
+          "WriteUserTimeline", ctx,
+          Obj({{"req_id", Json(req_id)}, {"post_id", Json(post_id)},
+               {"user_id", Json(creator_id)},
+               {"timestamp", post["timestamp"]}}));
+    });
+    auto f_home = std::async(std::launch::async, [&, ctx] {
+      JsonArray mention_ids;
+      for (const auto& m : post["user_mentions"].as_array())
+        mention_ids.push_back(m["user_id"]);
+      Json msg = Obj({{"req_id", Json(req_id)}, {"post_id", Json(post_id)},
+                      {"user_id", Json(creator_id)},
+                      {"timestamp", post["timestamp"]},
+                      {"user_mentions", Json(std::move(mention_ids))},
+                      {"trace", Json(JsonArray{Json(ctx.trace_id),
+                                               Json(ctx.span_id)})}});
+      mq->Call("publish", Unsampled(),
+               Obj({{"queue", Json(kHomeTimelineQueue)}, {"message", msg}}));
+    });
+    f_store.get();
+    f_timeline.get();
+    f_home.get();
+    redis->Call("del", ctx, Obj({{"key", req_id}}));
+  };
+
+  auto upload_fragment = [=](const std::string& field) {
+    return [=](const TraceContext& ctx, const Json& a) {
+      std::string req_id = a["req_id"].as_string();
+      redis->Call("hset", ctx,
+                  Obj({{"key", Json(req_id)}, {"field", Json(field)},
+                       {"value", a["value"]}}));
+      int64_t n = redis->Call("hincrby", ctx,
+                              Obj({{"key", Json(req_id)},
+                                   {"field", Json("num_components")},
+                                   {"by", Json(1)}}))
+                      .as_int();
+      redis->Call("expire", ctx,
+                  Obj({{"key", Json(req_id)}, {"ttl_ms", Json(10000)}}));
+      if (n == kNumComposeComponents) compose_and_upload(ctx, req_id);
+      return Json(true);
+    };
+  };
+
+  server->Register("UploadCreator", upload_fragment("creator"));
+  server->Register("UploadText", upload_fragment("text"));
+  server->Register("UploadMedia", upload_fragment("media"));
+  server->Register("UploadUrls", upload_fragment("urls"));
+  server->Register("UploadUserMentions", upload_fragment("user_mentions"));
+  server->Register("UploadUniqueId", upload_fragment("unique_id"));
+}
+
+// ---------------------------------------------------------------------------
+// unique-id-service: snowflake post ids (reference: UniqueIdHandler.h:92-120)
+
+void RegisterUniqueId(RpcServer* server, ClusterConfig* cfg) {
+  auto* compose = cfg->PoolFor("compose-post-service");
+  auto machine = std::make_shared<uint64_t>(MachineId() & 0x3FF);
+  auto mu = std::make_shared<std::mutex>();
+  auto last_ms = std::make_shared<uint64_t>(0);
+  auto counter = std::make_shared<uint64_t>(0);
+
+  server->Register("UploadUniqueId", [=](const TraceContext& ctx, const Json& a) {
+    uint64_t id;
+    {
+      std::lock_guard<std::mutex> lock(*mu);
+      uint64_t ms = NowNs() / 1000000;
+      if (ms == *last_ms) {
+        ++*counter;
+      } else {
+        *last_ms = ms;
+        *counter = 0;
+      }
+      id = (ms << 20) | (*machine << 10) | (*counter & 0x3FF);
+    }
+    compose->Call("UploadUniqueId", ctx,
+                  Obj({{"req_id", a["req_id"]}, {"value", Json(id)}}));
+    return Json(id);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// text-service: url + mention extraction with parallel downstream upload
+// (reference: TextHandler.h:81-164)
+
+void RegisterText(RpcServer* server, ClusterConfig* cfg) {
+  auto* url_shorten = cfg->PoolFor("url-shorten-service");
+  auto* user_mention = cfg->PoolFor("user-mention-service");
+  auto* compose = cfg->PoolFor("compose-post-service");
+
+  server->Register("UploadText", [=](const TraceContext& ctx, const Json& a) {
+    std::string text = a["text"].as_string();
+    std::string req_id = a["req_id"].as_string();
+
+    static const std::regex kUrlRe(R"((https?://[^\s]+))");
+    static const std::regex kMentionRe(R"(@([A-Za-z0-9_\-]+))");
+    JsonArray urls, mentions;
+    for (auto it = std::sregex_iterator(text.begin(), text.end(), kUrlRe);
+         it != std::sregex_iterator(); ++it)
+      urls.push_back(Json(it->str(1)));
+    for (auto it = std::sregex_iterator(text.begin(), text.end(), kMentionRe);
+         it != std::sregex_iterator(); ++it)
+      mentions.push_back(Json(it->str(1)));
+
+    auto f_urls = std::async(std::launch::async, [&, ctx] {
+      return url_shorten->Call(
+          "UploadUrls", ctx,
+          Obj({{"req_id", Json(req_id)}, {"urls", Json(urls)}}));
+    });
+    auto f_mentions = std::async(std::launch::async, [&, ctx] {
+      user_mention->Call(
+          "UploadUserMentions", ctx,
+          Obj({{"req_id", Json(req_id)}, {"usernames", Json(mentions)}}));
+    });
+    Json shortened = f_urls.get();
+    f_mentions.get();
+
+    // Substitute shortened urls into the text (reference: TextHandler.h:146-…).
+    std::string updated = text;
+    const auto& pairs = shortened.as_array();
+    for (const auto& p : pairs) {
+      const std::string& from = p["expanded_url"].as_string();
+      const std::string& to = p["shortened_url"].as_string();
+      size_t pos = updated.find(from);
+      if (pos != std::string::npos) updated.replace(pos, from.size(), to);
+    }
+    compose->Call("UploadText", ctx,
+                  Obj({{"req_id", Json(req_id)}, {"value", Json(updated)}}));
+    return Json(true);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// url-shorten-service (reference: UrlShortenHandler.h:61-167)
+
+void RegisterUrlShorten(RpcServer* server, ClusterConfig* cfg) {
+  auto* mongo = cfg->PoolFor("url-shorten-mongodb");
+  auto* compose = cfg->PoolFor("compose-post-service");
+
+  server->Register("UploadUrls", [=](const TraceContext& ctx, const Json& a) {
+    JsonArray out;
+    for (const auto& u : a["urls"].as_array()) {
+      Json pair = Obj({{"expanded_url", u},
+                       {"shortened_url", Json("http://short.url/" + RandomShortUrl())}});
+      mongo->Call("insert", ctx,
+                  Obj({{"coll", Json("url")}, {"doc", pair}}));
+      out.push_back(std::move(pair));
+    }
+    compose->Call("UploadUrls", ctx,
+                  Obj({{"req_id", a["req_id"]}, {"value", Json(out)}}));
+    return Json(std::move(out));
+  });
+  server->Register("GetExtendedUrls", [=](const TraceContext& ctx, const Json& a) {
+    JsonArray out;
+    for (const auto& u : a["shortened_urls"].as_array()) {
+      Json doc = mongo->Call("findone", ctx,
+                             Obj({{"coll", Json("url")},
+                                  {"field", Json("shortened_url")},
+                                  {"value", u}}));
+      out.push_back(doc["expanded_url"]);
+    }
+    return Json(std::move(out));
+  });
+}
+
+// ---------------------------------------------------------------------------
+// user-mention-service (reference: UserMentionHandler.h:68-238 — memcached
+// multi-get, mongo fallback)
+
+void RegisterUserMention(RpcServer* server, ClusterConfig* cfg) {
+  auto* cache = cfg->PoolFor("user-memcached");
+  auto* mongo = cfg->PoolFor("user-mongodb");
+  auto* compose = cfg->PoolFor("compose-post-service");
+
+  server->Register("UploadUserMentions", [=](const TraceContext& ctx, const Json& a) {
+    JsonArray mentions;
+    const auto& usernames = a["usernames"].as_array();
+    if (!usernames.empty()) {
+      JsonArray keys;
+      for (const auto& u : usernames)
+        keys.push_back(Json("user-id:" + u.as_string()));
+      Json cached = cache->Call("mget", ctx, Obj({{"keys", Json(keys)}}));
+      for (const auto& u : usernames) {
+        std::string key = "user-id:" + u.as_string();
+        if (cached.has(key)) {
+          mentions.push_back(Obj({{"user_id", cached[key]}, {"username", u}}));
+        } else {
+          Json doc = mongo->Call("findone", ctx,
+                                 Obj({{"coll", Json("user")},
+                                      {"field", Json("username")},
+                                      {"value", u}}));
+          if (doc.is_object())
+            mentions.push_back(Obj({{"user_id", doc["user_id"]}, {"username", u}}));
+        }
+      }
+    }
+    compose->Call("UploadUserMentions", ctx,
+                  Obj({{"req_id", a["req_id"]}, {"value", Json(mentions)}}));
+    return Json(true);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// media-service: pass-through (reference: MediaHandler.h:92 — bytes never
+// transit this service)
+
+void RegisterMedia(RpcServer* server, ClusterConfig* cfg) {
+  auto* compose = cfg->PoolFor("compose-post-service");
+  server->Register("UploadMedia", [=](const TraceContext& ctx, const Json& a) {
+    JsonArray media;
+    if (a.has("media_id") && !a["media_id"].is_null())
+      media.push_back(Obj({{"media_id", a["media_id"]},
+                           {"media_type", a["media_type"]}}));
+    compose->Call("UploadMedia", ctx,
+                  Obj({{"req_id", a["req_id"]}, {"value", Json(std::move(media))}}));
+    return Json(true);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// user-service (reference: UserHandler.h — salted SHA-256, cached login,
+// token issuance, creator upload)
+
+void RegisterUser(RpcServer* server, ClusterConfig* cfg) {
+  auto* mongo = cfg->PoolFor("user-mongodb");
+  auto* cache = cfg->PoolFor("user-memcached");
+  auto* compose = cfg->PoolFor("compose-post-service");
+  auto* social = cfg->PoolFor("social-graph-service");
+  std::string secret = cfg->secret();
+
+  server->Register("RegisterUserWithId", [=](const TraceContext& ctx, const Json& a) {
+    std::string salt = RandomShortUrl();
+    Json doc = Obj({{"user_id", a["user_id"]}, {"username", a["username"]},
+                    {"salt", Json(salt)},
+                    {"password_hash",
+                     Json(Sha256::HexDigest(a["password"].as_string() + salt))}});
+    mongo->Call("insert", ctx, Obj({{"coll", Json("user")}, {"doc", doc}}));
+    social->Call("InsertUser", ctx, Obj({{"user_id", a["user_id"]}}));
+    return Json(true);
+  });
+
+  server->Register("Login", [=](const TraceContext& ctx, const Json& a) {
+    std::string username = a["username"].as_string();
+    Json doc = cache->Call("get", ctx, Obj({{"key", Json("login:" + username)}}));
+    if (!doc.is_object()) {
+      doc = mongo->Call("findone", ctx,
+                        Obj({{"coll", Json("user")}, {"field", Json("username")},
+                             {"value", Json(username)}}));
+      if (!doc.is_object()) throw std::runtime_error("no such user " + username);
+      cache->Call("set", ctx,
+                  Obj({{"key", Json("login:" + username)}, {"value", doc}}));
+    }
+    std::string expect = Sha256::HexDigest(a["password"].as_string() +
+                                           doc["salt"].as_string());
+    if (expect != doc["password_hash"].as_string())
+      throw std::runtime_error("bad password");
+    int64_t expiry = static_cast<int64_t>(NowNs() / 1000000000) + 3600;
+    std::string payload = username + "." + std::to_string(expiry);
+    return Json(payload + "." + Sha256::HexDigest(secret + "|" + payload));
+  });
+
+  server->Register("UploadCreatorWithUserId", [=](const TraceContext& ctx, const Json& a) {
+    Json creator = Obj({{"user_id", a["user_id"]}, {"username", a["username"]}});
+    compose->Call("UploadCreator", ctx,
+                  Obj({{"req_id", a["req_id"]}, {"value", creator}}));
+    return Json(true);
+  });
+}
+
+// ---------------------------------------------------------------------------
+// social-graph-service (reference: SocialGraphHandler.h — parallel follower/
+// followee updates, redis-first reads with mongo fallback + backfill)
+
+void RegisterSocialGraph(RpcServer* server, ClusterConfig* cfg) {
+  auto* mongo = cfg->PoolFor("social-graph-mongodb");
+  auto* redis = cfg->PoolFor("social-graph-redis");
+
+  server->Register("InsertUser", [=](const TraceContext& ctx, const Json& a) {
+    mongo->Call("insert", ctx,
+                Obj({{"coll", Json("social-graph")},
+                     {"doc", Obj({{"user_id", a["user_id"]},
+                                  {"followers", Json(JsonArray{})},
+                                  {"followees", Json(JsonArray{})}})}}));
+    return Json(true);
+  });
+
+  server->Register("Follow", [=](const TraceContext& ctx, const Json& a) {
+    const Json& user = a["user_id"];
+    const Json& followee = a["followee_id"];
+    double now = static_cast<double>(NowNs() / 1000000);
+    // Parallel graph updates (reference: std::async joined at
+    // SocialGraphHandler.h:259-261).
+    auto f1 = std::async(std::launch::async, [&, ctx] {
+      mongo->Call("update", ctx,
+                  Obj({{"coll", Json("social-graph")}, {"field", Json("user_id")},
+                       {"value", user}, {"array_field", Json("followees")},
+                       {"push", followee}}));
+    });
+    auto f2 = std::async(std::launch::async, [&, ctx] {
+      mongo->Call("update", ctx,
+                  Obj({{"coll", Json("social-graph")}, {"field", Json("user_id")},
+                       {"value", followee}, {"array_field", Json("followers")},
+                       {"push", user}}));
+    });
+    auto f3 = std::async(std::launch::async, [&, ctx] {
+      redis->Call("zadd", ctx,
+                  Obj({{"key", Json("followees:" + user.dump())},
+                       {"score", Json(now)}, {"member", Json(followee.dump())}}));
+      redis->Call("zadd", ctx,
+                  Obj({{"key", Json("followers:" + followee.dump())},
+                       {"score", Json(now)}, {"member", Json(user.dump())}}));
+    });
+    f1.get();
+    f2.get();
+    f3.get();
+    return Json(true);
+  });
+
+  server->Register("Unfollow", [=](const TraceContext& ctx, const Json& a) {
+    const Json& user = a["user_id"];
+    const Json& followee = a["followee_id"];
+    auto f1 = std::async(std::launch::async, [&, ctx] {
+      mongo->Call("pull", ctx,
+                  Obj({{"coll", Json("social-graph")}, {"field", Json("user_id")},
+                       {"value", user}, {"array_field", Json("followees")},
+                       {"pull", followee}}));
+    });
+    auto f2 = std::async(std::launch::async, [&, ctx] {
+      mongo->Call("pull", ctx,
+                  Obj({{"coll", Json("social-graph")}, {"field", Json("user_id")},
+                       {"value", followee}, {"array_field", Json("followers")},
+                       {"pull", user}}));
+    });
+    auto f3 = std::async(std::launch::async, [&, ctx] {
+      redis->Call("zrem", ctx,
+                  Obj({{"key", Json("followees:" + user.dump())},
+                       {"member", Json(followee.dump())}}));
+      redis->Call("zrem", ctx,
+                  Obj({{"key", Json("followers:" + followee.dump())},
+                       {"member", Json(user.dump())}}));
+    });
+    f1.get();
+    f2.get();
+    f3.get();
+    return Json(true);
+  });
+
+  auto get_edges = [=](const char* redis_prefix, const char* doc_field) {
+    return [=](const TraceContext& ctx, const Json& a) {
+      std::string key = std::string(redis_prefix) + a["user_id"].dump();
+      Json members = redis->Call(
+          "zrange", ctx,
+          Obj({{"key", Json(key)}, {"start", Json(0)}, {"stop", Json(-1)}}));
+      JsonArray ids;
+      for (const auto& m : members.as_array())
+        ids.push_back(Json::parse(m.as_string()));
+      if (ids.empty()) {
+        // Cache miss: mongo fallback + redis backfill (reference pattern).
+        Json doc = mongo->Call("findone", ctx,
+                               Obj({{"coll", Json("social-graph")},
+                                    {"field", Json("user_id")},
+                                    {"value", a["user_id"]}}));
+        double now = static_cast<double>(NowNs() / 1000000);
+        for (const auto& f : doc[doc_field].as_array()) {
+          ids.push_back(f);
+          redis->Call("zadd", ctx,
+                      Obj({{"key", Json(key)}, {"score", Json(now)},
+                           {"member", Json(f.dump())}}));
+        }
+      }
+      return Json(std::move(ids));
+    };
+  };
+  server->Register("GetFollowers", get_edges("followers:", "followers"));
+  server->Register("GetFollowees", get_edges("followees:", "followees"));
+}
+
+// ---------------------------------------------------------------------------
+// post-storage-service (reference: PostStorageHandler.h — memcached
+// lookaside over mongo)
+
+void RegisterPostStorage(RpcServer* server, ClusterConfig* cfg) {
+  auto* mongo = cfg->PoolFor("post-storage-mongodb");
+  auto* cache = cfg->PoolFor("post-storage-memcached");
+
+  server->Register("StorePost", [=](const TraceContext& ctx, const Json& a) {
+    mongo->Call("insert", ctx,
+                Obj({{"coll", Json("post")}, {"doc", a["post"]}}));
+    return Json(true);
+  });
+
+  server->Register("ReadPosts", [=](const TraceContext& ctx, const Json& a) {
+    JsonArray keys;
+    for (const auto& id : a["post_ids"].as_array())
+      keys.push_back(Json("post:" + id.dump()));
+    Json cached = cache->Call("mget", ctx, Obj({{"keys", Json(keys)}}));
+    JsonArray posts;
+    for (const auto& id : a["post_ids"].as_array()) {
+      std::string key = "post:" + id.dump();
+      if (cached.has(key)) {
+        posts.push_back(cached[key]);
+        continue;
+      }
+      Json doc = mongo->Call("findone", ctx,
+                             Obj({{"coll", Json("post")},
+                                  {"field", Json("post_id")}, {"value", id}}));
+      if (doc.is_object()) {
+        cache->Call("set", ctx, Obj({{"key", Json(key)}, {"value", doc}}));
+        posts.push_back(std::move(doc));
+      }
+    }
+    return Json(std::move(posts));
+  });
+}
+
+// ---------------------------------------------------------------------------
+// user-timeline-service (reference: UserTimelineHandler.h — mongo push +
+// redis cache; reads redis-first with mongo fallback + backfill)
+
+void RegisterUserTimeline(RpcServer* server, ClusterConfig* cfg) {
+  auto* mongo = cfg->PoolFor("user-timeline-mongodb");
+  auto* redis = cfg->PoolFor("user-timeline-redis");
+  auto* post_storage = cfg->PoolFor("post-storage-service");
+
+  server->Register("WriteUserTimeline", [=](const TraceContext& ctx, const Json& a) {
+    mongo->Call("update", ctx,
+                Obj({{"coll", Json("user-timeline")}, {"field", Json("user_id")},
+                     {"value", a["user_id"]}, {"array_field", Json("posts")},
+                     {"push", Obj({{"post_id", a["post_id"]},
+                                   {"timestamp", a["timestamp"]}})}}));
+    redis->Call("zadd", ctx,
+                Obj({{"key", Json("ut:" + a["user_id"].dump())},
+                     {"score", a["timestamp"]},
+                     {"member", Json(a["post_id"].dump())}}));
+    return Json(true);
+  });
+
+  server->Register("ReadUserTimeline", [=](const TraceContext& ctx, const Json& a) {
+    std::string key = "ut:" + a["user_id"].dump();
+    Json members = redis->Call("zrevrange", ctx,
+                               Obj({{"key", Json(key)}, {"start", a["start"]},
+                                    {"stop", a["stop"]}}));
+    JsonArray post_ids;
+    for (const auto& m : members.as_array())
+      post_ids.push_back(Json::parse(m.as_string()));
+    if (post_ids.empty()) {
+      Json doc = mongo->Call("findone", ctx,
+                             Obj({{"coll", Json("user-timeline")},
+                                  {"field", Json("user_id")},
+                                  {"value", a["user_id"]}}));
+      for (const auto& p : doc["posts"].as_array()) {
+        post_ids.push_back(p["post_id"]);
+        redis->Call("zadd", ctx,
+                    Obj({{"key", Json(key)}, {"score", p["timestamp"]},
+                         {"member", Json(p["post_id"].dump())}}));
+      }
+    }
+    return post_storage->Call("ReadPosts", ctx,
+                              Obj({{"post_ids", Json(std::move(post_ids))}}));
+  });
+}
+
+// ---------------------------------------------------------------------------
+// home-timeline-service (reference: HomeTimelineHandler.h:73-102)
+
+void RegisterHomeTimeline(RpcServer* server, ClusterConfig* cfg) {
+  auto* redis = cfg->PoolFor("home-timeline-redis");
+  auto* post_storage = cfg->PoolFor("post-storage-service");
+
+  server->Register("ReadHomeTimeline", [=](const TraceContext& ctx, const Json& a) {
+    Json members = redis->Call("zrevrange", ctx,
+                               Obj({{"key", Json("ht:" + a["user_id"].dump())},
+                                    {"start", a["start"]}, {"stop", a["stop"]}}));
+    JsonArray post_ids;
+    for (const auto& m : members.as_array())
+      post_ids.push_back(Json::parse(m.as_string()));
+    return post_storage->Call("ReadPosts", ctx,
+                              Obj({{"post_ids", Json(std::move(post_ids))}}));
+  });
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+
+void RegisterAppService(const std::string& component, RpcServer* server,
+                        ClusterConfig* cfg) {
+  if (component == "compose-post-service") return RegisterComposePost(server, cfg);
+  if (component == "unique-id-service") return RegisterUniqueId(server, cfg);
+  if (component == "text-service") return RegisterText(server, cfg);
+  if (component == "url-shorten-service") return RegisterUrlShorten(server, cfg);
+  if (component == "user-mention-service") return RegisterUserMention(server, cfg);
+  if (component == "media-service") return RegisterMedia(server, cfg);
+  if (component == "user-service") return RegisterUser(server, cfg);
+  if (component == "social-graph-service") return RegisterSocialGraph(server, cfg);
+  if (component == "post-storage-service") return RegisterPostStorage(server, cfg);
+  if (component == "user-timeline-service") return RegisterUserTimeline(server, cfg);
+  if (component == "home-timeline-service") return RegisterHomeTimeline(server, cfg);
+  throw std::runtime_error("unknown app service: " + component);
+}
+
+bool IsAppService(const std::string& component) {
+  static const std::set<std::string> kServices = {
+      "compose-post-service", "unique-id-service",  "text-service",
+      "url-shorten-service",  "user-mention-service", "media-service",
+      "user-service",         "social-graph-service", "post-storage-service",
+      "user-timeline-service", "home-timeline-service"};
+  return kServices.count(component) > 0;
+}
+
+// ---------------------------------------------------------------------------
+// write-home-timeline-service: queue consumer workers (reference:
+// WriteHomeTimelineService.cpp — 4 threads, GetFollowers, zadd fan-out)
+
+void RunHomeTimelineWriter(ClusterConfig* cfg, int workers,
+                           const std::atomic<bool>* running) {
+  auto* mq = cfg->PoolFor("rabbitmq");
+  auto* social = cfg->PoolFor("social-graph-service");
+  auto* redis = cfg->PoolFor("home-timeline-redis");
+
+  auto worker = [=] {
+    while (running == nullptr || running->load()) {
+      Json msg;
+      try {
+        msg = mq->Call("consume", Unsampled(),
+                       Obj({{"queue", Json(kHomeTimelineQueue)},
+                            {"timeout_ms", Json(1000)}}));
+      } catch (const std::exception& e) {
+        SNS_LOG(LogLevel::Warning, std::string("consume failed: ") + e.what());
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        continue;
+      }
+      if (!msg.is_object()) continue;  // poll timeout
+      // Re-extract the producer's span context from the message (reference:
+      // WriteHomeTimelineService.cpp:33-50) so the consumer span joins the
+      // compose trace across the async boundary.
+      TraceContext parent;
+      const auto& t = msg["trace"].as_array();
+      if (t.size() == 2) {
+        parent.trace_id = t[0].as_uint();
+        parent.span_id = t[1].as_uint();
+      }
+      try {
+        ScopedSpan span(parent, "/Consume", "write-home-timeline-service");
+        const TraceContext& ctx = span.context();
+        Json followers = social->Call("GetFollowers", ctx,
+                                      Obj({{"user_id", msg["user_id"]}}));
+        // followers ∪ mentioned users (reference: :80-82)
+        std::set<std::string> targets;
+        for (const auto& f : followers.as_array()) targets.insert(f.dump());
+        for (const auto& m : msg["user_mentions"].as_array())
+          targets.insert(m.dump());
+        for (const auto& uid : targets)
+          redis->Call("zadd", ctx,
+                      Obj({{"key", Json("ht:" + uid)},
+                           {"score", msg["timestamp"]},
+                           {"member", Json(msg["post_id"].dump())}}));
+      } catch (const std::exception& e) {
+        SNS_LOG(LogLevel::Warning,
+                std::string("home-timeline write failed: ") + e.what());
+      }
+    }
+  };
+
+  std::vector<std::thread> pool;
+  for (int i = 0; i < workers; ++i) pool.emplace_back(worker);
+  for (auto& t : pool) t.join();
+}
+
+}  // namespace sns

@@ -1,0 +1,28 @@
+// The twelve social-network application services (SURVEY.md §2.2 service
+// table), each a thin handler set over the shared RPC runtime. Behavior is
+// re-derived from the reference call stacks (SURVEY.md §3.1-3.2), not
+// transcribed: the compose saga, snowflake ids, url/mention extraction,
+// timeline caching with datastore fallback, follower fan-out via the queue
+// consumer.
+#pragma once
+
+#include <string>
+
+#include "common.h"
+
+namespace sns {
+
+// Registers the handlers for `component` on `server`. Knows every app
+// service name; throws for unknown components.
+void RegisterAppService(const std::string& component, RpcServer* server,
+                        ClusterConfig* config);
+
+// write-home-timeline-service is a queue consumer, not an RPC server
+// (reference: WriteHomeTimelineService.cpp — AMQP consumer with worker
+// threads). Blocks; `workers` consumer loops.
+void RunHomeTimelineWriter(ClusterConfig* config, int workers = 4,
+                           const std::atomic<bool>* running = nullptr);
+
+bool IsAppService(const std::string& component);
+
+}  // namespace sns

@@ -1,0 +1,435 @@
+#include "store.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+
+namespace sns {
+
+// ---------------------------------------------------------------------------
+// KvEngine
+
+void KvEngine::MaybeExpire(const std::string& key) {
+  auto it = expiry_ns_.find(key);
+  if (it != expiry_ns_.end() && NowNs() >= it->second) {
+    hashes_.erase(key);
+    zsets_.erase(key);
+    expiry_ns_.erase(it);
+  }
+}
+
+void KvEngine::HSet(const std::string& key, const std::string& field,
+                    std::string value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  hashes_[key][field] = std::move(value);
+}
+
+int64_t KvEngine::HIncrBy(const std::string& key, const std::string& field,
+                          int64_t by) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  auto& slot = hashes_[key][field];
+  int64_t v = slot.empty() ? 0 : std::stoll(slot);
+  v += by;
+  slot = std::to_string(v);
+  return v;
+}
+
+Json KvEngine::HGetAll(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  JsonObject out;
+  auto it = hashes_.find(key);
+  if (it != hashes_.end())
+    for (const auto& [f, v] : it->second) out[f] = Json(v);
+  return Json(std::move(out));
+}
+
+void KvEngine::ZAdd(const std::string& key, double score,
+                    const std::string& member) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  zsets_[key][member] = score;
+}
+
+std::vector<std::string> KvEngine::ZRange(const std::string& key, int64_t start,
+                                          int64_t stop, bool reverse) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  std::vector<std::string> out;
+  auto it = zsets_.find(key);
+  if (it == zsets_.end()) return out;
+  // Materialize rank order (score asc, member asc as tiebreak — redis rules).
+  std::vector<std::pair<double, std::string>> ranked;
+  ranked.reserve(it->second.size());
+  for (const auto& [m, s] : it->second) ranked.emplace_back(s, m);
+  std::sort(ranked.begin(), ranked.end());
+  if (reverse) std::reverse(ranked.begin(), ranked.end());
+  int64_t n = static_cast<int64_t>(ranked.size());
+  if (start < 0) start += n;
+  if (stop < 0) stop += n;
+  start = std::max<int64_t>(0, start);
+  stop = std::min<int64_t>(n - 1, stop);
+  for (int64_t i = start; i <= stop; ++i) out.push_back(ranked[i].second);
+  return out;
+}
+
+void KvEngine::ZRem(const std::string& key, const std::string& member) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  auto it = zsets_.find(key);
+  if (it != zsets_.end()) it->second.erase(member);
+}
+
+int64_t KvEngine::ZCard(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MaybeExpire(key);
+  auto it = zsets_.find(key);
+  return it == zsets_.end() ? 0 : static_cast<int64_t>(it->second.size());
+}
+
+void KvEngine::Expire(const std::string& key, int64_t ttl_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expiry_ns_[key] = NowNs() + static_cast<uint64_t>(ttl_ms) * 1000000ull;
+}
+
+void KvEngine::Del(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  hashes_.erase(key);
+  zsets_.erase(key);
+  expiry_ns_.erase(key);
+}
+
+size_t KvEngine::ApproxBytes() {
+  std::lock_guard<std::mutex> lock(mu_);
+  size_t n = 0;
+  for (const auto& [k, h] : hashes_) {
+    n += k.size();
+    for (const auto& [f, v] : h) n += f.size() + v.size() + 32;
+  }
+  for (const auto& [k, z] : zsets_) {
+    n += k.size();
+    n += z.size() * 48;
+    for (const auto& [m, s] : z) { (void)s; n += m.size(); }
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// DocEngine
+
+DocEngine::Collection& DocEngine::Coll(const std::string& name) {
+  return colls_[name];
+}
+
+void DocEngine::CreateIndex(const std::string& collection,
+                            const std::string& field) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& c = Coll(collection);
+  auto& idx = c.indexes[field];
+  idx.clear();
+  for (size_t i = 0; i < c.docs.size(); ++i)
+    if (c.docs[i].has(field)) idx[IndexKey(c.docs[i][field])].push_back(i);
+}
+
+void DocEngine::IndexDoc(Collection& c, size_t i) {
+  for (auto& [field, idx] : c.indexes)
+    if (c.docs[i].has(field)) idx[IndexKey(c.docs[i][field])].push_back(i);
+}
+
+void DocEngine::Insert(const std::string& collection, const Json& doc) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& c = Coll(collection);
+  c.docs.push_back(doc);
+  IndexDoc(c, c.docs.size() - 1);
+}
+
+Json DocEngine::FindOne(const std::string& collection, const std::string& field,
+                        const Json& value) {
+  Json all = Find(collection, field, value, 1);
+  const auto& arr = all.as_array();
+  return arr.empty() ? Json() : arr[0];
+}
+
+Json DocEngine::Find(const std::string& collection, const std::string& field,
+                     const Json& value, int64_t limit) {
+  std::lock_guard<std::mutex> lock(mu_);
+  JsonArray out;
+  auto cit = colls_.find(collection);
+  if (cit == colls_.end()) return Json(std::move(out));
+  auto& c = cit->second;
+  std::string key = IndexKey(value);
+  auto iit = c.indexes.find(field);
+  if (iit != c.indexes.end()) {
+    auto hit = iit->second.find(key);
+    if (hit != iit->second.end())
+      for (size_t i : hit->second) {
+        if (limit >= 0 && static_cast<int64_t>(out.size()) >= limit) break;
+        out.push_back(c.docs[i]);
+      }
+  } else {
+    for (const auto& d : c.docs) {
+      if (limit >= 0 && static_cast<int64_t>(out.size()) >= limit) break;
+      if (d.has(field) && IndexKey(d[field]) == key) out.push_back(d);
+    }
+  }
+  return Json(std::move(out));
+}
+
+void DocEngine::PushFront(const std::string& collection, const std::string& field,
+                          const Json& match, const std::string& array_field,
+                          const Json& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& c = Coll(collection);
+  std::string key = IndexKey(match);
+  Json* doc = nullptr;
+  auto iit = c.indexes.find(field);
+  if (iit != c.indexes.end()) {
+    auto hit = iit->second.find(key);
+    if (hit != iit->second.end() && !hit->second.empty())
+      doc = &c.docs[hit->second.front()];
+  } else {
+    for (auto& d : c.docs)
+      if (d.has(field) && IndexKey(d[field]) == key) { doc = &d; break; }
+  }
+  if (doc == nullptr) {  // upsert
+    Json fresh;
+    fresh.set(field, match).set(array_field, Json(JsonArray{}));
+    c.docs.push_back(std::move(fresh));
+    IndexDoc(c, c.docs.size() - 1);
+    doc = &c.docs.back();
+  }
+  auto& arr = doc->mutable_object()[array_field].mutable_array();
+  arr.insert(arr.begin(), value);
+}
+
+void DocEngine::Pull(const std::string& collection, const std::string& field,
+                     const Json& match, const std::string& array_field,
+                     const Json& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto cit = colls_.find(collection);
+  if (cit == colls_.end()) return;
+  std::string key = IndexKey(match);
+  std::string victim = IndexKey(value);
+  for (auto& d : cit->second.docs) {
+    if (!d.has(field) || IndexKey(d[field]) != key) continue;
+    auto& arr = d.mutable_object()[array_field].mutable_array();
+    arr.erase(std::remove_if(arr.begin(), arr.end(),
+                             [&](const Json& v) { return IndexKey(v) == victim; }),
+              arr.end());
+  }
+}
+
+size_t DocEngine::ApproxBytes() {
+  std::lock_guard<std::mutex> lock(mu_);
+  size_t n = 0;
+  for (const auto& [name, c] : colls_) {
+    n += name.size();
+    for (const auto& d : c.docs) n += d.dump().size() + 32;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// CacheEngine
+
+void CacheEngine::Set(const std::string& key, std::string value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = map_.find(key);
+  if (it != map_.end()) {
+    it->second->second = std::move(value);
+    lru_.splice(lru_.begin(), lru_, it->second);
+    return;
+  }
+  lru_.emplace_front(key, std::move(value));
+  map_[key] = lru_.begin();
+  if (map_.size() > capacity_) {
+    map_.erase(lru_.back().first);
+    lru_.pop_back();
+  }
+}
+
+bool CacheEngine::Get(const std::string& key, std::string* value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = map_.find(key);
+  if (it == map_.end()) return false;
+  lru_.splice(lru_.begin(), lru_, it->second);
+  *value = it->second->second;
+  return true;
+}
+
+size_t CacheEngine::ApproxBytes() {
+  std::lock_guard<std::mutex> lock(mu_);
+  size_t n = 0;
+  for (const auto& [k, v] : lru_) n += k.size() + v.size() + 48;
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// QueueEngine
+
+void QueueEngine::Publish(const std::string& queue, std::string message) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    queues_[queue].push_back(std::move(message));
+  }
+  cv_.notify_all();
+}
+
+bool QueueEngine::Consume(const std::string& queue, int timeout_ms,
+                          std::string* message) {
+  std::unique_lock<std::mutex> lock(mu_);
+  auto ready = [&] {
+    auto it = queues_.find(queue);
+    return it != queues_.end() && !it->second.empty();
+  };
+  if (!cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms), ready))
+    return false;
+  auto& q = queues_[queue];
+  *message = std::move(q.front());
+  q.pop_front();
+  return true;
+}
+
+size_t QueueEngine::Depth(const std::string& queue) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = queues_.find(queue);
+  return it == queues_.end() ? 0 : it->second.size();
+}
+
+// ---------------------------------------------------------------------------
+// RPC wrappers
+
+void RegisterKvService(RpcServer* server, KvEngine* e) {
+  server->Register("hset", [e](const TraceContext&, const Json& a) {
+    e->HSet(a["key"].as_string(), a["field"].as_string(), a["value"].dump());
+    return Json(true);
+  });
+  server->Register("hincrby", [e](const TraceContext&, const Json& a) {
+    return Json(e->HIncrBy(a["key"].as_string(), a["field"].as_string(),
+                           a["by"].as_int(1)));
+  });
+  server->Register("hgetall", [e](const TraceContext&, const Json& a) {
+    return e->HGetAll(a["key"].as_string());
+  });
+  server->Register("zadd", [e](const TraceContext&, const Json& a) {
+    e->ZAdd(a["key"].as_string(), a["score"].as_double(),
+            a["member"].as_string());
+    return Json(true);
+  });
+  auto zrange = [e](const Json& a, bool reverse) {
+    JsonArray out;
+    for (auto& m : e->ZRange(a["key"].as_string(), a["start"].as_int(0),
+                             a["stop"].as_int(-1), reverse))
+      out.push_back(Json(std::move(m)));
+    return Json(std::move(out));
+  };
+  server->Register("zrange", [zrange](const TraceContext&, const Json& a) {
+    return zrange(a, false);
+  });
+  server->Register("zrevrange", [zrange](const TraceContext&, const Json& a) {
+    return zrange(a, true);
+  });
+  server->Register("zrem", [e](const TraceContext&, const Json& a) {
+    e->ZRem(a["key"].as_string(), a["member"].as_string());
+    return Json(true);
+  });
+  server->Register("zcard", [e](const TraceContext&, const Json& a) {
+    return Json(e->ZCard(a["key"].as_string()));
+  });
+  server->Register("expire", [e](const TraceContext&, const Json& a) {
+    e->Expire(a["key"].as_string(), a["ttl_ms"].as_int(10000));
+    return Json(true);
+  });
+  server->Register("del", [e](const TraceContext&, const Json& a) {
+    e->Del(a["key"].as_string());
+    return Json(true);
+  });
+  server->Register("bytes", [e](const TraceContext&, const Json&) {
+    return Json(static_cast<uint64_t>(e->ApproxBytes()));
+  });
+}
+
+void RegisterDocService(RpcServer* server, DocEngine* e) {
+  server->Register("insert", [e](const TraceContext&, const Json& a) {
+    e->Insert(a["coll"].as_string(), a["doc"]);
+    return Json(true);
+  });
+  server->Register("find", [e](const TraceContext&, const Json& a) {
+    return e->Find(a["coll"].as_string(), a["field"].as_string(), a["value"],
+                   a["limit"].as_int(-1));
+  });
+  server->Register("findone", [e](const TraceContext&, const Json& a) {
+    return e->FindOne(a["coll"].as_string(), a["field"].as_string(), a["value"]);
+  });
+  server->Register("update", [e](const TraceContext&, const Json& a) {
+    e->PushFront(a["coll"].as_string(), a["field"].as_string(), a["value"],
+                 a["array_field"].as_string(), a["push"]);
+    return Json(true);
+  });
+  server->Register("pull", [e](const TraceContext&, const Json& a) {
+    e->Pull(a["coll"].as_string(), a["field"].as_string(), a["value"],
+            a["array_field"].as_string(), a["pull"]);
+    return Json(true);
+  });
+  server->Register("createindex", [e](const TraceContext&, const Json& a) {
+    e->CreateIndex(a["coll"].as_string(), a["field"].as_string());
+    return Json(true);
+  });
+  server->Register("bytes", [e](const TraceContext&, const Json&) {
+    return Json(static_cast<uint64_t>(e->ApproxBytes()));
+  });
+}
+
+void RegisterCacheService(RpcServer* server, CacheEngine* e) {
+  server->Register("set", [e](const TraceContext&, const Json& a) {
+    e->Set(a["key"].as_string(), a["value"].dump());
+    return Json(true);
+  });
+  server->Register("get", [e](const TraceContext&, const Json& a) {
+    std::string v;
+    if (!e->Get(a["key"].as_string(), &v)) return Json();
+    return Json::parse(v);
+  });
+  server->Register("mget", [e](const TraceContext&, const Json& a) {
+    JsonObject out;
+    for (const auto& k : a["keys"].as_array()) {
+      std::string v;
+      if (e->Get(k.as_string(), &v)) out[k.as_string()] = Json::parse(v);
+    }
+    return Json(std::move(out));
+  });
+}
+
+void RegisterQueueService(RpcServer* server, QueueEngine* e) {
+  server->Register("publish", [e](const TraceContext&, const Json& a) {
+    e->Publish(a["queue"].as_string(), a["message"].dump());
+    return Json(true);
+  });
+  server->Register("consume", [e](const TraceContext&, const Json& a) {
+    std::string msg;
+    if (!e->Consume(a["queue"].as_string(),
+                    static_cast<int>(a["timeout_ms"].as_int(1000)), &msg))
+      return Json();
+    return Json::parse(msg);
+  });
+  server->Register("depth", [e](const TraceContext&, const Json& a) {
+    return Json(static_cast<uint64_t>(e->Depth(a["queue"].as_string())));
+  });
+}
+
+std::string StoreKindFor(const std::string& component) {
+  auto ends_with = [&](const char* suffix) {
+    size_t n = strlen(suffix);
+    return component.size() >= n &&
+           component.compare(component.size() - n, n, suffix) == 0;
+  };
+  if (ends_with("-redis")) return "kv";
+  if (ends_with("-mongodb")) return "doc";
+  if (ends_with("-memcached")) return "cache";
+  if (component == "rabbitmq" || ends_with("-mq")) return "queue";
+  return "";
+}
+
+}  // namespace sns

@@ -1,0 +1,141 @@
+// Embedded datastore engines + their RPC service wrappers.
+//
+// The reference app delegates state to external Redis / MongoDB / memcached
+// / RabbitMQ processes (SURVEY.md §2.2 datastores column). Those are not
+// available (and would not be ours to build); the equivalent here is a set
+// of native in-process engines served over the same RPC plane, one process
+// per store component (compose-post-redis, user-mongodb, ...), so that
+// datastore hops still appear as distinct components in span trees and get
+// their own /proc resource metrics — which is exactly what the estimation
+// model needs them for.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <list>
+#include <map>
+#include <mutex>
+#include <condition_variable>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace sns {
+
+// ---------------------------------------------------------------------------
+// Redis-style: string/hash/zset keyspaces with lazy expiry.
+
+class KvEngine {
+ public:
+  void HSet(const std::string& key, const std::string& field, std::string value);
+  int64_t HIncrBy(const std::string& key, const std::string& field, int64_t by);
+  Json HGetAll(const std::string& key);
+  void ZAdd(const std::string& key, double score, const std::string& member);
+  void ZRem(const std::string& key, const std::string& member);
+  // start/stop are inclusive rank bounds; stop=-1 means "to the end".
+  std::vector<std::string> ZRange(const std::string& key, int64_t start,
+                                  int64_t stop, bool reverse);
+  int64_t ZCard(const std::string& key);
+  void Expire(const std::string& key, int64_t ttl_ms);
+  void Del(const std::string& key);
+  size_t ApproxBytes();
+
+ private:
+  void MaybeExpire(const std::string& key);
+  std::mutex mu_;
+  std::unordered_map<std::string, std::map<std::string, std::string>> hashes_;
+  std::unordered_map<std::string, std::map<std::string, double>> zsets_;
+  std::unordered_map<std::string, uint64_t> expiry_ns_;
+};
+
+// ---------------------------------------------------------------------------
+// Mongo-style: collections of JSON documents, hash indexes, append-to-front
+// list update (the reference's `$push $position 0` upsert,
+// UserTimelineHandler.h:90-108).
+
+class DocEngine {
+ public:
+  void CreateIndex(const std::string& collection, const std::string& field);
+  void Insert(const std::string& collection, const Json& doc);
+  Json FindOne(const std::string& collection, const std::string& field,
+               const Json& value);
+  Json Find(const std::string& collection, const std::string& field,
+            const Json& value, int64_t limit);
+  // Push `value` to the front of array field `array_field` of the doc where
+  // `field == match`, creating the doc if absent.
+  void PushFront(const std::string& collection, const std::string& field,
+                 const Json& match, const std::string& array_field,
+                 const Json& value);
+  // Remove every element equal to `value` from the array field (mongo $pull).
+  void Pull(const std::string& collection, const std::string& field,
+            const Json& match, const std::string& array_field, const Json& value);
+  size_t ApproxBytes();
+
+ private:
+  struct Collection {
+    std::vector<Json> docs;
+    // field -> (serialized value -> doc indexes)
+    std::map<std::string, std::unordered_map<std::string, std::vector<size_t>>>
+        indexes;
+  };
+  Collection& Coll(const std::string& name);
+  static std::string IndexKey(const Json& v) { return v.dump(); }
+  void IndexDoc(Collection& c, size_t idx);
+  std::mutex mu_;
+  std::map<std::string, Collection> colls_;
+};
+
+// ---------------------------------------------------------------------------
+// Memcached-style LRU cache.
+
+class CacheEngine {
+ public:
+  explicit CacheEngine(size_t capacity = 1 << 16) : capacity_(capacity) {}
+  void Set(const std::string& key, std::string value);
+  bool Get(const std::string& key, std::string* value);
+  size_t ApproxBytes();
+
+ private:
+  size_t capacity_;
+  std::mutex mu_;
+  std::list<std::pair<std::string, std::string>> lru_;  // front = most recent
+  std::unordered_map<std::string,
+                     std::list<std::pair<std::string, std::string>>::iterator>
+      map_;
+};
+
+// ---------------------------------------------------------------------------
+// RabbitMQ-style named queues with blocking consume (long-poll over RPC).
+
+class QueueEngine {
+ public:
+  void Publish(const std::string& queue, std::string message);
+  // Blocks up to timeout_ms; returns false on timeout.
+  bool Consume(const std::string& queue, int timeout_ms, std::string* message);
+  size_t Depth(const std::string& queue);
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::deque<std::string>> queues_;
+};
+
+// ---------------------------------------------------------------------------
+// RPC service wrappers. Each registers lowercase method names so the
+// per-call server spans ("/hset", "/find", "/mget", ...) line up with the
+// trace vocabulary the featurizer and the workload simulator share
+// (deeprest_tpu/workload/topology.py).
+
+void RegisterKvService(RpcServer* server, KvEngine* engine);
+void RegisterDocService(RpcServer* server, DocEngine* engine);
+void RegisterCacheService(RpcServer* server, CacheEngine* engine);
+void RegisterQueueService(RpcServer* server, QueueEngine* engine);
+
+// Store-type dispatch by component naming convention ("-redis", "-mongodb",
+// "-memcached", "rabbitmq"); returns empty string for app services.
+std::string StoreKindFor(const std::string& component);
+
+}  // namespace sns
