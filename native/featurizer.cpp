@@ -20,6 +20,7 @@
 //
 // Build: make -C native   (g++ -O3 -shared; tsan variant available).
 
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -147,7 +148,11 @@ class Parser {
         return m;
     }
 
-    Span parse_span() {
+    Span parse_span(int depth = 0) {
+        // Depth cap mirrors Python's RecursionError on the same input: a
+        // pathological trace must raise a catchable error, not overflow the
+        // C stack inside the host process.
+        if (depth > 900) fail("span tree too deep");
         Span s;
         expect('{');
         bool first = true;
@@ -160,7 +165,7 @@ class Parser {
             skip_ws(); expect(':'); skip_ws();
             if (key == "component") s.component = parse_string();
             else if (key == "operation") s.operation = parse_string();
-            else if (key == "children") parse_array([&] { s.children.push_back(parse_span()); });
+            else if (key == "children") parse_array([&] { s.children.push_back(parse_span(depth + 1)); });
             else skip_value();
         }
         return s;
@@ -242,27 +247,44 @@ class Parser {
         return code;
     }
 
+    bool try_literal(const char* lit) {
+        size_t n = std::strlen(lit);
+        if (static_cast<size_t>(end_ - p_) >= n && std::strncmp(p_, lit, n) == 0) {
+            p_ += n;
+            return true;
+        }
+        return false;
+    }
+
     double parse_number() {
+        // Python's json.dump (allow_nan default) emits these bare literals;
+        // accept them so round-trip corpora parse identically both paths.
+        if (try_literal("NaN")) return NAN;
+        if (try_literal("Infinity")) return HUGE_VAL;
+        if (try_literal("-Infinity")) return -HUGE_VAL;
         const char* start = p_;
         while (p_ < end_ && (std::isdigit(static_cast<unsigned char>(*p_)) ||
                              *p_ == '-' || *p_ == '+' || *p_ == '.' ||
                              *p_ == 'e' || *p_ == 'E'))
             ++p_;
         if (p_ == start) fail("expected number");
-        std::string text(start, p_);
-        try {
-            return std::stod(text);
-        } catch (const std::out_of_range&) {
+        // from_chars is locale-independent (std::stod honors LC_NUMERIC set
+        // by whatever host process dlopen'ed this library).
+        double v = 0.0;
+        auto res = std::from_chars(start, p_, v);
+        if (res.ec == std::errc::result_out_of_range) {
             // Match Python json.loads: overflow saturates to +/-inf,
             // underflow to 0.
+            std::string text(start, p_);
             bool neg = text[0] == '-';
             bool tiny = text.find("e-") != std::string::npos ||
                         text.find("E-") != std::string::npos;
             if (tiny) return neg ? -0.0 : 0.0;
             return neg ? -HUGE_VAL : HUGE_VAL;
-        } catch (const std::exception&) {
-            fail("bad number '" + text + "'");
         }
+        if (res.ec != std::errc() || res.ptr != p_)
+            fail("bad number '" + std::string(start, p_) + "'");
+        return v;
     }
 
     void skip_value() {
@@ -347,6 +369,7 @@ struct Vocab {
 
 struct CorpusStats {
     Vocab vocab;
+    bool build_vocab = true;  // false in hash mode: columns come from the hash
     std::vector<std::string> metric_keys;            // first-bucket order
     std::unordered_map<std::string, int64_t> metric_idx;
     Vocab components;                                // component -> idx
@@ -359,7 +382,7 @@ void walk_observe(const Span& s, std::string& prefix, CorpusStats& stats) {
     prefix += s.component;
     prefix.push_back('_');
     prefix += s.operation;
-    stats.vocab.observe(prefix);
+    if (stats.build_vocab) stats.vocab.observe(prefix);
     stats.components.observe(s.component);
     for (const Span& c : s.children) walk_observe(c, prefix, stats);
     prefix.resize(saved);
@@ -447,6 +470,7 @@ void featurize_file(const std::string& in_path, const std::string& out_dir,
 
     // ---- pass 1: vocabulary / metric keys / components ----
     CorpusStats stats;
+    stats.build_vocab = !cfg.hash_mode;
     for_each_line(in_path, [&](const std::string& line, int64_t) {
         Parser parser(line.data(), line.data() + line.size());
         Bucket b = parser.parse_bucket();
@@ -481,8 +505,15 @@ void featurize_file(const std::string& in_path, const std::string& out_dir,
 
     const size_t T = static_cast<size_t>(stats.num_buckets);
     const size_t M = stats.metric_keys.size();
-    const size_t C = stats.components.ordered.size() + 1;  // + "general"
-    const size_t general_idx = C - 1;
+    // The synthetic whole-trace counter shares the "general" slot with a
+    // real component of that name if one exists (Python count_invocations
+    // merges them into one key the same way).
+    auto general_it = stats.components.index.find("general");
+    const bool general_observed = general_it != stats.components.index.end();
+    const size_t C = stats.components.ordered.size() + (general_observed ? 0 : 1);
+    const size_t general_idx = general_observed
+        ? static_cast<size_t>(general_it->second)
+        : C - 1;
 
     std::vector<float> traffic(T * capacity, 0.0f);
     std::vector<float> resources(T * M, 0.0f);
@@ -492,6 +523,9 @@ void featurize_file(const std::string& in_path, const std::string& out_dir,
     Extractor ex{stats, cfg, capacity};
     int64_t t = 0;
     for_each_line(in_path, [&](const std::string& line, int64_t) {
+        if (t >= static_cast<int64_t>(T))
+            throw ParseError("input grew between passes (" +
+                             std::to_string(T) + " buckets counted)");
         Parser parser(line.data(), line.data() + line.size());
         Bucket b = parser.parse_bucket();
         float* row = traffic.data() + t * capacity;
@@ -527,9 +561,11 @@ void featurize_file(const std::string& in_path, const std::string& out_dir,
     for (size_t i = 0; i < M; ++i)
         hdr << (i ? "," : "") << '"' << json_escape(stats.metric_keys[i]) << '"';
     hdr << "],\"components\":[";
-    for (size_t i = 0; i + 1 < C; ++i)
+    for (size_t i = 0; i < stats.components.ordered.size(); ++i)
         hdr << (i ? "," : "") << '"' << json_escape(stats.components.ordered[i]) << '"';
-    hdr << (C > 1 ? "," : "") << "\"general\"]";
+    if (!general_observed)
+        hdr << (stats.components.ordered.empty() ? "" : ",") << "\"general\"";
+    hdr << "]";
     hdr << ",\"vocab\":[";
     if (!cfg.hash_mode) {
         for (size_t i = 0; i < stats.vocab.ordered.size(); ++i)

@@ -1,6 +1,7 @@
 """Native ETL parity: the C++ featurizer must reproduce the Python pipeline
 bit-for-bit on the same corpus, in both dictionary and hash modes."""
 
+import os
 import subprocess
 
 import numpy as np
@@ -137,10 +138,50 @@ def test_native_error_reporting(tmp_path):
         featurize_jsonl(str(bad), cfg, require_native=True)
 
 
+def test_component_named_general_parity(tmp_path):
+    """A real component named "general" must share the synthetic whole-trace
+    counter slot exactly as the Python side merges them."""
+    from deeprest_tpu.data.schema import Bucket, MetricSample, Span
+
+    buckets = [
+        Bucket(
+            metrics=[MetricSample("general", "cpu", float(t))],
+            traces=[Span("general", "/op", [Span("svc", "/x")])] * (t + 1),
+        )
+        for t in range(3)
+    ]
+    path = tmp_path / "general.jsonl"
+    save_raw_data_jsonl(buckets, str(path))
+    cfg = FeaturizeConfig(round_to=8)
+    py = featurize_buckets(buckets, cfg)
+    cc = featurize_jsonl(str(path), cfg, require_native=True)
+    assert_featurized_equal(py, cc)
+
+
+def test_nan_and_infinity_metric_values(tmp_path):
+    """json.dump writes bare NaN/Infinity literals; both paths must accept
+    them (the arrays carry them through)."""
+    from deeprest_tpu.data.schema import Bucket, MetricSample, Span
+
+    buckets = [
+        Bucket(metrics=[MetricSample("c", "cpu", v)],
+               traces=[Span("c", "/op")])
+        for v in (float("nan"), float("inf"), float("-inf"))
+    ]
+    path = tmp_path / "nan.jsonl"
+    save_raw_data_jsonl(buckets, str(path))
+    cfg = FeaturizeConfig(round_to=8)
+    cc = featurize_jsonl(str(path), cfg, require_native=True)
+    series = cc.resources["c_cpu"]
+    assert np.isnan(series[0]) and np.isposinf(series[1]) and np.isneginf(series[2])
+
+
 def test_tsan_build_clean(corpus_file, tmp_path):
     """The thread-sanitized selftest binary must run the full ETL without
     reports (an instrumented .so cannot be dlopen'ed into plain Python)."""
-    res = subprocess.run(["make", "-C", "/root/repo/native", "tsan"],
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    res = subprocess.run(["make", "-C", native_dir, "tsan"],
                          capture_output=True, text=True)
     if res.returncode != 0:
         pytest.skip(f"tsan unavailable: {res.stderr[-200:]}")
@@ -148,7 +189,7 @@ def test_tsan_build_clean(corpus_file, tmp_path):
     out = tmp_path / "tsan_out"
     out.mkdir()
     res = subprocess.run(
-        ["/root/repo/native/etl_selftest_tsan", path, str(out)],
+        [os.path.join(native_dir, "etl_selftest_tsan"), path, str(out)],
         capture_output=True, text=True,
     )
     assert res.returncode == 0, res.stderr[-500:]
