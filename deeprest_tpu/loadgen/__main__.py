@@ -1,0 +1,85 @@
+"""End-to-end corpus capture CLI.
+
+Boots the native cluster, warms up the social graph, drives a scenario, and
+leaves a raw-data JSONL corpus ready for featurization — the whole L0-L3
+loop the reference spreads across minikube + k8s + locust (SURVEY.md §3.5),
+in one command:
+
+    python -m deeprest_tpu.loadgen --scenario=normal --ticks=30 \\
+        --tick-seconds=2 --out=raw_data.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+from deeprest_tpu.loadgen.burner import Burner
+from deeprest_tpu.loadgen.cluster import SnsCluster
+from deeprest_tpu.loadgen.graph import synthetic_social_graph
+from deeprest_tpu.loadgen.runner import LoadRunner, RunnerConfig
+from deeprest_tpu.loadgen.warmup import warmup
+from deeprest_tpu.workload.scenarios import SCENARIOS
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="deeprest_tpu.loadgen")
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="normal")
+    ap.add_argument("--ticks", type=int, default=30, help="scenario buckets to run")
+    ap.add_argument("--tick-seconds", type=float, default=2.0)
+    ap.add_argument("--interval-ms", type=int, default=None,
+                    help="collector bucket length (default: tick length)")
+    ap.add_argument("--out", default="raw_data.jsonl")
+    ap.add_argument("--users", type=int, default=96, help="graph population")
+    ap.add_argument("--user-scale", type=float, default=0.1,
+                    help="scales the scenario user curve to local capacity")
+    ap.add_argument("--think-min", type=float, default=1.0)
+    ap.add_argument("--think-max", type=float, default=3.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--burn-component", default="compose-post-service",
+                    help="crypto scenario: component the burner impersonates")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    scenario = SCENARIOS[args.scenario](args.seed)
+    graph = synthetic_social_graph(args.users, seed=args.seed)
+    interval = args.interval_ms or int(args.tick_seconds * 1000)
+
+    with SnsCluster(out_path=args.out, interval_ms=interval,
+                    verbose=args.verbose) as cluster:
+        print(f"cluster up; gateway {cluster.gateway_addr}", file=sys.stderr)
+        stats = warmup(*cluster.gateway_addr, graph)
+        print(f"warmup: {stats}", file=sys.stderr)
+        runner = LoadRunner(
+            cluster.gateway_addr, graph, scenario,
+            RunnerConfig(tick_seconds=args.tick_seconds,
+                         think_time=(args.think_min, args.think_max),
+                         user_scale=args.user_scale, seed=args.seed),
+            media_addr=cluster.media_addr,
+        )
+        burner = None
+        timer = None
+        if args.scenario == "crypto":
+            # burn through the middle half of the run — clean baseline
+            # buckets on both sides, like the reference's mid-experiment
+            # injection
+            burner = Burner(args.ticks * args.tick_seconds / 2,
+                            collector_addr=cluster.collector_addr,
+                            component=args.burn_component)
+            timer = threading.Timer(args.ticks * args.tick_seconds / 4,
+                                    burner.start)
+            timer.start()
+        run_stats = runner.run(args.ticks)
+        if timer is not None:
+            timer.cancel()
+        if burner is not None:
+            burner.stop()
+        cluster.stop(drain_s=1.5)
+    print(json.dumps({"scenario": args.scenario, "out": args.out, **run_stats}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""Cryptojacking injection: a bitcoin-style double-SHA-256 proof-of-work
+CPU burner (reference: locust/pow.py:29-38).
+
+The reference injects ``pow.py`` into a running pod so its CPU shows up in
+that pod's cadvisor metrics without any traffic to justify it — the anomaly
+the estimator is meant to flag. The native equivalent: the burner runs as a
+child process that *registers its own pid under a victim component's name*
+with the trace collector, so the sampled CPU is attributed to that
+component (see native/sns/collector.cpp RegisterProcess).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import subprocess
+import sys
+import time
+
+
+def proof_of_work(header: bytes, difficulty_bits: int,
+                  max_iters: int = 1 << 22, start_nonce: int = 0) -> tuple[int, bytes]:
+    """Find a nonce whose double-SHA-256 meets the difficulty target.
+
+    Returns ``(nonce, digest)``; nonce is -1 if ``max_iters`` ran out. The
+    loop structure mirrors the reference burner (pow.py:29-38): increment
+    nonce, hash(hash(header||nonce)), compare against target.
+    """
+    target = 1 << (256 - difficulty_bits)
+    nonce = start_nonce
+    for _ in range(max_iters):
+        data = header + struct.pack("<Q", nonce)
+        digest = hashlib.sha256(hashlib.sha256(data).digest()).digest()
+        if int.from_bytes(digest, "big") < target:
+            return nonce, digest
+        nonce += 1
+    return -1, b""
+
+
+def burn(duration_s: float, difficulty_bits: int = 28) -> int:
+    """Burn CPU for ``duration_s`` seconds; returns hash iterations done."""
+    iters = 0
+    header = os.urandom(32)
+    deadline = time.monotonic() + duration_s
+    nonce = 0
+    while time.monotonic() < deadline:
+        chunk = 20_000
+        found, _ = proof_of_work(header, difficulty_bits, max_iters=chunk,
+                                 start_nonce=nonce)
+        nonce = nonce + chunk if found < 0 else 0
+        if found >= 0:
+            header = os.urandom(32)
+        iters += chunk
+    return iters
+
+
+class Burner:
+    """Runs the burner as a child process, optionally attributed to a
+    victim component via collector registration."""
+
+    def __init__(self, duration_s: float, collector_addr: tuple[str, int] | None = None,
+                 component: str | None = None):
+        self.duration_s = duration_s
+        self.collector_addr = collector_addr
+        self.component = component
+        self._proc: subprocess.Popen | None = None
+
+    def start(self) -> "Burner":
+        cmd = [sys.executable, "-m", "deeprest_tpu.loadgen.burner",
+               f"--duration={self.duration_s}"]
+        if self.collector_addr and self.component:
+            host, port = self.collector_addr
+            cmd += [f"--collector={host}:{port}", f"--component={self.component}"]
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        )
+        return self
+
+    def wait(self) -> None:
+        if self._proc is not None:
+            self._proc.wait()
+
+    def stop(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+
+    def __enter__(self) -> "Burner":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def _main(argv: list[str]) -> int:
+    duration, collector, component = 5.0, None, None
+    for arg in argv:
+        if arg.startswith("--duration="):
+            duration = float(arg.split("=", 1)[1])
+        elif arg.startswith("--collector="):
+            host, port = arg.split("=", 1)[1].rsplit(":", 1)
+            collector = (host, int(port))
+        elif arg.startswith("--component="):
+            component = arg.split("=", 1)[1]
+    if collector and component:
+        from deeprest_tpu.loadgen.client import register_with_collector
+
+        register_with_collector(collector[0], collector[1], component, os.getpid())
+    burn(duration)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

@@ -1,0 +1,191 @@
+"""Boot and supervise a native snsd cluster (process-per-role).
+
+The reference's equivalent is the Kubernetes deployment: 31 Service +
+Deployment YAMLs, one pod per microservice/datastore (reference:
+social-network/social-network-deploy/k8s-yaml/ — SURVEY.md §2.2). Here the
+same component set runs as local processes of the one ``snsd`` binary, with
+the trace collector in the Jaeger+Prometheus role writing the raw-data JSONL
+contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+
+STORES = (
+    "compose-post-redis", "user-timeline-redis", "home-timeline-redis",
+    "social-graph-redis", "user-mongodb", "post-storage-mongodb",
+    "user-timeline-mongodb", "social-graph-mongodb", "url-shorten-mongodb",
+    "media-mongodb", "user-memcached", "post-storage-memcached", "rabbitmq",
+)
+SERVICES = (
+    "compose-post-service", "unique-id-service", "text-service",
+    "url-shorten-service", "user-mention-service", "media-service",
+    "user-service", "social-graph-service", "post-storage-service",
+    "user-timeline-service", "home-timeline-service",
+)
+GATEWAYS = ("nginx-thrift", "media-frontend")
+CONSUMER = "write-home-timeline-service"
+COLLECTOR = "trace-collector"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def snsd_path() -> str:
+    return os.environ.get(
+        "DEEPREST_SNSD", os.path.join(_REPO_ROOT, "native", "sns", "snsd")
+    )
+
+
+def snsd_available() -> bool:
+    return os.access(snsd_path(), os.X_OK)
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class SnsCluster:
+    """Context manager owning one process per component.
+
+    >>> with SnsCluster(out_path="raw.jsonl", interval_ms=1000) as cluster:
+    ...     GatewayClient(*cluster.gateway_addr) ...
+    """
+
+    def __init__(self, out_path: str, interval_ms: int = 5000,
+                 grace_ms: int = 1000, verbose: bool = False):
+        self.out_path = os.path.abspath(out_path)
+        self.interval_ms = interval_ms
+        self.grace_ms = grace_ms
+        self.verbose = verbose
+        self.components: dict[str, tuple[str, int]] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._config_path: str | None = None
+
+    # -- addresses ------------------------------------------------------
+
+    @property
+    def gateway_addr(self) -> tuple[str, int]:
+        return self.components["nginx-thrift"]
+
+    @property
+    def media_addr(self) -> tuple[str, int]:
+        return self.components["media-frontend"]
+
+    @property
+    def collector_addr(self) -> tuple[str, int]:
+        return self.components[COLLECTOR]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self, timeout: float = 20.0) -> "SnsCluster":
+        if not snsd_available():
+            raise RuntimeError(f"snsd not built at {snsd_path()} (make -C native/sns)")
+        named = list(STORES) + list(SERVICES) + list(GATEWAYS) + [COLLECTOR]
+        ports = _free_ports(len(named))
+        self.components = {c: ("127.0.0.1", p) for c, p in zip(named, ports)}
+
+        self._config_path = self.out_path + ".cluster.json"
+        with open(self._config_path, "w", encoding="utf-8") as f:
+            json.dump({"components": {
+                c: {"host": h, "port": p} for c, (h, p) in self.components.items()
+            }}, f, indent=2)
+
+        try:
+            # Collector first (registration target), then state, then logic.
+            self._spawn(COLLECTOR, extra=[
+                f"--out={self.out_path}",
+                f"--interval-ms={self.interval_ms}",
+                f"--grace-ms={self.grace_ms}",
+            ])
+            for c in STORES:
+                self._spawn(c)
+            for c in SERVICES:
+                self._spawn(c)
+            self._spawn(CONSUMER)
+            for c in GATEWAYS:
+                self._spawn(c)
+            self._wait_ready(timeout)
+        except Exception:
+            self.stop()
+            raise
+        return self
+
+    def _spawn(self, component: str, extra: list[str] | None = None) -> None:
+        cmd = [snsd_path(), f"--service={component}", f"--config={self._config_path}"]
+        cmd += extra or []
+        if self.verbose:
+            cmd.append("--verbose")
+        out = None if self.verbose else subprocess.DEVNULL
+        self._procs[component] = subprocess.Popen(cmd, stdout=out, stderr=out)
+
+    def _wait_ready(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        pending = set(self.components)
+        while pending and time.monotonic() < deadline:
+            for c in sorted(pending):
+                proc = self._procs.get(c)
+                if proc is not None and proc.poll() is not None:
+                    raise RuntimeError(f"{c} exited with {proc.returncode} during boot")
+                host, port = self.components[c]
+                try:
+                    with socket.create_connection((host, port), timeout=0.25):
+                        pending.discard(c)
+                except OSError:
+                    pass
+            if pending:
+                time.sleep(0.05)
+        if pending:
+            raise TimeoutError(f"components never came up: {sorted(pending)}")
+
+    def stop(self, drain_s: float = 0.0) -> None:
+        """SIGTERM the app first so span sinks flush into the collector,
+        then the collector so its final buckets land in the output file."""
+        if drain_s:
+            time.sleep(drain_s)
+        app = [c for c in self._procs if c != COLLECTOR]
+        for c in app:
+            self._terminate(c)
+        for c in app:
+            self._reap(c)
+        if COLLECTOR in self._procs:
+            self._terminate(COLLECTOR)
+            self._reap(COLLECTOR)
+        self._procs.clear()
+
+    def _terminate(self, component: str) -> None:
+        proc = self._procs.get(component)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    def _reap(self, component: str, timeout: float = 8.0) -> None:
+        proc = self._procs.get(component)
+        if proc is None:
+            return
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    def __enter__(self) -> "SnsCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

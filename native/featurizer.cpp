@@ -541,6 +541,9 @@ void featurize_file(const std::string& in_path, const std::string& out_dir,
                 static_cast<float>(m.value);
         ++t;
     });
+    if (t != static_cast<int64_t>(T))
+        throw ParseError("input shrank between passes (" + std::to_string(T) +
+                         " buckets counted, " + std::to_string(t) + " re-read)");
 
     // ---- write outputs ----
     auto write_bin = [&](const std::string& name, const std::vector<float>& v) {

@@ -1,0 +1,189 @@
+"""L3 load-generation plane: graph, burner, and the live end-to-end loop
+(cluster boot → warmup → scenario traffic → collector ETL → featurize) —
+the integration matrix the reference runs by hand with minikube + locust
+(SURVEY.md §4)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.featurize import featurize_buckets
+from deeprest_tpu.data.schema import load_raw_data
+from deeprest_tpu.loadgen import (
+    Burner, GatewayClient, LoadRunner, RunnerConfig, SnsCluster,
+    proof_of_work, register_with_collector, snsd_available,
+    synthetic_social_graph, warmup,
+)
+from deeprest_tpu.workload.scenarios import normal_scenario
+
+needs_snsd = pytest.mark.skipif(
+    not snsd_available(), reason="snsd not built (make -C native/sns)")
+
+
+# ---------------------------------------------------------------------------
+# Unit: graph + burner (no cluster needed)
+
+def test_graph_deterministic_and_scale_free():
+    g1 = synthetic_social_graph(96, seed=3)
+    g2 = synthetic_social_graph(96, seed=3)
+    assert g1.edges == g2.edges
+    assert g1.num_users == 96
+    # both directions present
+    assert (1, 2) in g1.edges and (2, 1) in g1.edges
+    degrees = [len(g1.friends(u)) for u in range(1, 97)]
+    assert min(degrees) >= 1
+    # preferential attachment -> heavy tail: max degree well above median
+    assert max(degrees) >= 3 * int(np.median(degrees))
+
+
+def test_graph_usernames():
+    g = synthetic_social_graph(8, seed=0)
+    assert g.username(3) == "user3" and g.password(3) == "pw3"
+
+
+def test_proof_of_work_finds_low_difficulty_nonce():
+    nonce, digest = proof_of_work(b"header", difficulty_bits=8, max_iters=100_000)
+    assert nonce >= 0
+    assert digest[0] == 0  # 8 leading zero bits
+
+
+def test_proof_of_work_exhausts():
+    nonce, digest = proof_of_work(b"header", difficulty_bits=255, max_iters=10)
+    assert nonce == -1 and digest == b""
+
+
+# ---------------------------------------------------------------------------
+# Integration: live cluster
+
+@pytest.fixture(scope="module")
+def live_corpus(tmp_path_factory):
+    """Boot the full native cluster once, warm it up, drive a scaled-down
+    normal scenario, and return (buckets, stats)."""
+    if not snsd_available():
+        pytest.skip("snsd not built (make -C native/sns)")
+    out = str(tmp_path_factory.mktemp("live") / "raw.jsonl")
+    graph = synthetic_social_graph(24, seed=1)
+    scenario = normal_scenario(0)
+    with SnsCluster(out_path=out, interval_ms=500, grace_ms=300) as cluster:
+        stats = warmup(*cluster.gateway_addr, graph)
+        runner = LoadRunner(
+            cluster.gateway_addr, graph, scenario,
+            RunnerConfig(tick_seconds=0.7, think_time=(0.02, 0.08),
+                         user_scale=0.05, seed=0),
+            media_addr=cluster.media_addr,
+        )
+        run_stats = runner.run(6)
+        cluster.stop(drain_s=1.5)
+    buckets = load_raw_data(out)
+    return buckets, stats, run_stats
+
+
+@needs_snsd
+def test_warmup_registers_everyone(live_corpus):
+    _, stats, _ = live_corpus
+    assert stats["registered"] == 24
+    assert stats["followed"] == stats["edges"]
+
+
+@needs_snsd
+def test_traffic_flows_and_traces_collected(live_corpus):
+    buckets, _, run_stats = live_corpus
+    total = sum(v for k, v in run_stats.items()
+                if k not in ("error", "peak_users"))
+    assert total > 10, run_stats
+    assert run_stats.get("error", 0) <= total * 0.1, run_stats
+    assert len(buckets) >= 3
+    roots = {t.operation for b in buckets for t in b.traces}
+    assert "/wrk2-api/post/compose" in roots or "/wrk2-api/home-timeline/read" in roots
+
+
+@needs_snsd
+def test_live_corpus_featurizes(live_corpus):
+    buckets, _, _ = live_corpus
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=32))
+    assert data.traffic.shape[0] == len(buckets)
+    assert data.traffic.sum() > 0
+    # the collector samples the five modeled resource kinds
+    resources = {k.rsplit("_", 1)[1] for k in data.resources}
+    assert "cpu" in resources
+    cpu_keys = [k for k in data.resources if k.endswith("_cpu")]
+    assert any(np.asarray(data.resources[k]).sum() > 0 for k in cpu_keys)
+
+
+@needs_snsd
+def test_end_to_end_read_your_own_write(live_corpus, tmp_path):
+    """Independent of the runner: a user's post must land on a follower's
+    home timeline through the full native saga."""
+    _ = live_corpus  # ensure module cluster torn down (ports freed)
+    out = str(tmp_path / "e2e_raw.jsonl")
+    with SnsCluster(out_path=out, interval_ms=800) as cluster:
+        c = GatewayClient(*cluster.gateway_addr)
+        c.register(901, "user901", "pw901")
+        c.register(902, "user902", "pw902")
+        c.follow(902, 901)
+        c.compose(901, "user901", "ship it @user902 https://go.example/x")
+        time.sleep(0.8)  # async home-timeline fan-out
+        home = c.read_home_timeline(902)
+        assert "ship it" in str(home)
+        user = c.read_user_timeline(901)
+        assert "ship it" in str(user)
+        media = GatewayClient(*cluster.media_addr)
+        media_id = media.upload_media(b"\x00" * 512)["media_id"]
+        got = media.get_media(media_id)
+        assert str(got.get("media_id")) == media_id
+        c.close()
+        media.close()
+
+
+@needs_snsd
+def test_burner_attributes_cpu_to_victim_component(tmp_path):
+    """Cryptojack injection: with zero traffic, the victim component's CPU
+    must still rise while the burner runs — the exact signal the anomaly
+    detector flags (reference: locust/pow.py + locustfile-crypto.py)."""
+    out = str(tmp_path / "burn.jsonl")
+    victim = "compose-post-service"
+    with SnsCluster(out_path=out, interval_ms=500, grace_ms=200) as cluster:
+        with Burner(3.0, collector_addr=cluster.collector_addr,
+                    component=victim):
+            time.sleep(3.0)
+        cluster.stop(drain_s=1.0)
+    buckets = load_raw_data(out)
+    assert len(buckets) >= 3
+    cpu = [m.value for b in buckets for m in b.metrics
+           if m.component == victim and m.resource == "cpu"]
+    # the burner should push the victim's sampled CPU well above idle
+    assert max(cpu) > 0.3, cpu
+
+
+def test_register_with_collector_frame_format():
+    """The framing must match native FramedSocket: 4-byte BE length + JSON."""
+    import json
+    import socket
+    import struct
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    received = {}
+
+    def accept():
+        conn, _ = srv.accept()
+        hdr = conn.recv(4)
+        (length,) = struct.unpack(">I", hdr)
+        payload = b""
+        while len(payload) < length:
+            payload += conn.recv(length - len(payload))
+        received.update(json.loads(payload))
+        conn.close()
+
+    t = threading.Thread(target=accept)
+    t.start()
+    register_with_collector("127.0.0.1", port, "victim", 4242)
+    t.join(timeout=5)
+    srv.close()
+    assert received == {"register": "victim", "pid": 4242}
