@@ -32,6 +32,9 @@ class ModelConfig:
     dropout_rate: float = 0.50
     # bfloat16 matmuls on the MXU; params and loss stay float32.
     compute_dtype: str = "float32"
+    # GRU recurrence backend: 'auto' uses the fused pallas kernel on TPU
+    # and `lax.scan` elsewhere (ops/gru.py, ops/pallas_gru.py).
+    rnn_backend: str = "auto"
 
     @property
     def directions(self) -> int:

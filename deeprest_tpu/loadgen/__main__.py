@@ -71,11 +71,13 @@ def main(argv: list[str] | None = None) -> int:
             timer = threading.Timer(args.ticks * args.tick_seconds / 4,
                                     burner.start)
             timer.start()
-        run_stats = runner.run(args.ticks)
-        if timer is not None:
-            timer.cancel()
-        if burner is not None:
-            burner.stop()
+        try:
+            run_stats = runner.run(args.ticks)
+        finally:
+            if timer is not None:
+                timer.cancel()
+            if burner is not None:
+                burner.stop()
         cluster.stop(drain_s=1.5)
     print(json.dumps({"scenario": args.scenario, "out": args.out, **run_stats}))
     return 0

@@ -48,10 +48,13 @@ def burn(duration_s: float, difficulty_bits: int = 28) -> int:
         chunk = 20_000
         found, _ = proof_of_work(header, difficulty_bits, max_iters=chunk,
                                  start_nonce=nonce)
-        nonce = nonce + chunk if found < 0 else 0
-        if found >= 0:
+        if found < 0:
+            iters += chunk
+            nonce += chunk
+        else:
+            iters += found - nonce + 1
+            nonce = 0
             header = os.urandom(32)
-        iters += chunk
     return iters
 
 

@@ -105,9 +105,10 @@ class QuantileGRU(nn.Module):
                 bwd = gru_params(f"gru_bwd{sfx}", in_dim)
                 if layer == 0:
                     bwd = masked(bwd)
-                out = bidirectional_gru(cast(fwd), cast(bwd), out)
+                out = bidirectional_gru(cast(fwd), cast(bwd), out,
+                                        backend=cfg.rnn_backend)
             else:
-                out = gru(cast(fwd), out)
+                out = gru(cast(fwd), out, backend=cfg.rnn_backend)
             # layer 0 broadcasts [B,T,F] across experts; the output (and all
             # deeper layers) carry the expert axis: [E,B,T,D].
         rnn_out = out.astype(jnp.float32)

@@ -26,6 +26,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_BACKENDS = ("auto", "scan", "pallas", "pallas_interpret")
+
+
+def _resolve_backend(backend: str) -> str:
+    """'auto' → the fused pallas kernel on TPU, `lax.scan` elsewhere."""
+    if backend not in _BACKENDS:
+        raise ValueError(f"unknown GRU backend {backend!r}; one of {_BACKENDS}")
+    if backend == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "scan"
+    return backend
+
 
 class GRUParams(NamedTuple):
     """One direction of GRU weights with a leading expert axis.
@@ -96,12 +107,52 @@ def _gru_scan(
     return jnp.moveaxis(outs, 0, 2)  # [T,E,B,H] -> [E,B,T,H]
 
 
+def _gru_pallas(
+    params: GRUParams,
+    x: jax.Array,
+    h0: jax.Array,
+    reverse: bool,
+    interpret: bool,
+) -> jax.Array:
+    """Fused-kernel path: hoisted input projection (one MXU einsum), then the
+    pallas recurrence of ops/pallas_gru.py. Output matches the scan path's
+    layout/time-alignment; see that module for the kernel design."""
+    from deeprest_tpu.ops import pallas_gru
+
+    if x.ndim == 3:
+        proj = jnp.einsum("btf,efg->etbg", x, params.w_ih)
+    else:
+        proj = jnp.einsum("ebtf,efg->etbg", x, params.w_ih)
+    proj = proj + params.b_ih[:, None, None, :]
+
+    e, t, b, _ = proj.shape
+    b_pad = pallas_gru.pad_batch(b)
+    if b_pad != b:
+        proj = jnp.pad(proj, ((0, 0), (0, 0), (0, b_pad - b), (0, 0)))
+        h0 = jnp.pad(h0, ((0, 0), (0, b_pad - b), (0, 0)))
+    e_pad = -e % pallas_gru.E_BLK
+    w_hh, b_hh = params.w_hh, params.b_hh
+    if e_pad:
+        proj = jnp.pad(proj, ((0, e_pad), (0, 0), (0, 0), (0, 0)))
+        w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
+        b_hh = jnp.pad(b_hh, ((0, e_pad), (0, 0)))
+        h0 = jnp.pad(h0, ((0, e_pad), (0, 0), (0, 0)))
+    if reverse:
+        proj = jnp.flip(proj, axis=1)
+    h_all = pallas_gru.gru_recurrence(proj, w_hh, b_hh, h0, interpret)
+    if reverse:
+        h_all = jnp.flip(h_all, axis=1)
+    h_all = h_all[:e, :, :b]
+    return jnp.moveaxis(h_all, 1, 2).astype(x.dtype)  # [E,B,T,H]
+
+
 def gru(
     params: GRUParams,
     x: jax.Array,
     h0: jax.Array | None = None,
     reverse: bool = False,
     unroll: int = 4,
+    backend: str = "auto",
 ) -> jax.Array:
     """Single-direction GRU over the time axis.
 
@@ -114,6 +165,10 @@ def gru(
           with ``x`` (``out[:, :, t]`` is the state after consuming x[t] in
           scan order), matching the torch bidirectional layout.
       unroll: scan unroll factor (amortizes per-step overhead on TPU).
+      backend: 'auto' | 'scan' | 'pallas' | 'pallas_interpret'. 'auto'
+          picks the fused pallas kernel on TPU backends, `lax.scan`
+          elsewhere; 'pallas_interpret' runs the kernel in interpret mode
+          (CPU numerics tests).
 
     Returns: ``[E, B, T, H]`` hidden states.
     """
@@ -121,6 +176,13 @@ def gru(
     b = x.shape[-3]
     if h0 is None:
         h0 = jnp.zeros((e, b, params.hidden_size), dtype=x.dtype)
+    resolved = _resolve_backend(backend)
+    if resolved != "scan":
+        from deeprest_tpu.ops import pallas_gru
+
+        if pallas_gru.supported(x.shape[-2], params.hidden_size):
+            return _gru_pallas(params, x, h0, reverse,
+                               interpret=resolved == "pallas_interpret")
     return _gru_scan(params, x, h0, reverse=reverse, unroll=unroll)
 
 
@@ -129,12 +191,13 @@ def bidirectional_gru(
     bwd: GRUParams,
     x: jax.Array,
     unroll: int = 4,
+    backend: str = "auto",
 ) -> jax.Array:
     """Bidirectional GRU: ``[E, B, T, F] → [E, B, T, 2H]``.
 
     Output layout matches torch: last-dim halves are (forward, backward),
     each time-aligned with the input.
     """
-    out_f = gru(fwd, x, reverse=False, unroll=unroll)
-    out_b = gru(bwd, x, reverse=True, unroll=unroll)
+    out_f = gru(fwd, x, reverse=False, unroll=unroll, backend=backend)
+    out_b = gru(bwd, x, reverse=True, unroll=unroll, backend=backend)
     return jnp.concatenate([out_f, out_b], axis=-1)

@@ -1,0 +1,264 @@
+"""Fused pallas TPU kernel for the GRU recurrence.
+
+The `lax.scan` recurrence in ops/gru.py lowers to an XLA while-loop whose
+per-step state round-trips through HBM and whose per-step matmul is far too
+small to hide loop overhead (B=32, H=128 — latency-bound, SURVEY.md §7.3).
+This kernel runs the whole time loop *inside one pallas invocation*:
+
+- grid = (expert_blocks, T) with time as the innermost (sequential) grid
+  dimension; the hidden state lives in a VMEM scratch buffer that persists
+  across time steps — zero HBM traffic for the carry;
+- the hoisted input projections ``proj = x @ W_ih + b_ih`` (computed
+  outside, one large MXU matmul) stream through VMEM blocks, double-
+  buffered by the pallas pipeline;
+- ``W_hh`` is indexed only by the expert block, so it stays resident in
+  VMEM for all T steps of that block;
+- the backward pass is a second pallas kernel walking the grid in reverse
+  time order, recomputing gate activations from (proj, h_prev) — no
+  activation stash beyond the forward outputs — and accumulating weight
+  gradients in VMEM scratch, flushed to HBM on the final step.
+
+Only the recurrence is hand-written: input/output projections, the feature
+mask, mixing, and heads remain plain XLA einsums (models/qrnn.py), which
+XLA already fuses well. Numerics match ops/gru.py's scan (gate order r,z,n;
+``n = tanh(x_n + b_in + r · (h·W_hn + b_hn))``).
+
+Used automatically on TPU backends (ops/gru.py dispatch); `interpret=True`
+makes every entry point runnable on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Experts per kernel program: amortizes grid overhead while keeping
+# VMEM residency (W_hh alone is E_BLK * H * 3H * 4B).
+E_BLK = 8
+# f32 sublane granularity — batch is padded up to this.
+_SUBLANE = 8
+
+
+def _gates(xproj, gates_h):
+    """Shared gate math. xproj/gates_h: [B, 3H] → (r, z, n)."""
+    xr, xz, xn = jnp.split(xproj, 3, axis=-1)
+    hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return r, z, n, hn
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = h0_ref[...].astype(jnp.float32)
+
+    for i in range(proj_ref.shape[0]):  # static unroll over the expert block
+        h = h_scr[i]
+        w = w_ref[i].astype(jnp.float32)
+        gates_h = (
+            jax.lax.dot_general(h, w, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            + b_ref[i].astype(jnp.float32)
+        )
+        xproj = proj_ref[i, 0].astype(jnp.float32)
+        r, z, n, _ = _gates(xproj, gates_h)
+        h_new = (1.0 - z) * n + z * h
+        h_scr[i] = h_new
+        out_ref[i, 0] = h_new.astype(out_ref.dtype)
+
+
+def _fwd_call(proj, w_hh, b_hh, h0, interpret):
+    e, t, b, g3 = proj.shape
+    h = g3 // 3
+    eb = e // E_BLK if e % E_BLK == 0 else 1
+    e_blk = e // eb
+    grid = (eb, t)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((e_blk, 1, b, g3), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
+            pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((e_blk, 1, b, h), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((e, t, b, h), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(proj, w_hh, b_hh, h0)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
+                dproj_ref, dw_ref, db_ref, dh0_ref,
+                dh_scr, dw_scr, db_scr):
+    t = pl.program_id(1)
+    t_total = pl.num_programs(1)
+
+    @pl.when(t == 0)  # first grid step == last time step
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
+
+    for i in range(proj_ref.shape[0]):
+        h_prev = hprev_ref[i, 0].astype(jnp.float32)
+        w = w_ref[i].astype(jnp.float32)
+        gates_h = (
+            jax.lax.dot_general(h_prev, w, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            + b_ref[i].astype(jnp.float32)
+        )
+        xproj = proj_ref[i, 0].astype(jnp.float32)
+        r, z, n, hn = _gates(xproj, gates_h)
+
+        dh_total = dout_ref[i, 0].astype(jnp.float32) + dh_scr[i]
+        dn = dh_total * (1.0 - z)
+        dz = dh_total * (h_prev - n)
+        dtanh = dn * (1.0 - n * n)
+        da_r = dtanh * hn * r * (1.0 - r)
+        da_z = dz * z * (1.0 - z)
+        dhn = dtanh * r
+        dgates_h = jnp.concatenate([da_r, da_z, dhn], axis=-1)   # [B,3H]
+        dproj_ref[i, 0] = jnp.concatenate(
+            [da_r, da_z, dtanh], axis=-1
+        ).astype(dproj_ref.dtype)
+
+        # dh_prev = dh·z + dgates_h @ W_hhᵀ   (contract the 3H axis)
+        dh_scr[i] = dh_total * z + jax.lax.dot_general(
+            dgates_h, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dW_hh += h_prevᵀ @ dgates_h   (contract the batch axis)
+        dw_scr[i] += jax.lax.dot_general(
+            h_prev, dgates_h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_scr[i] += jnp.sum(dgates_h, axis=0)
+
+    @pl.when(t == t_total - 1)  # last grid step == time 0: flush accumulators
+    def _flush():
+        dw_ref[...] = dw_scr[...]
+        db_ref[...] = db_scr[...]
+        dh0_ref[...] = dh_scr[...]
+
+
+def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
+    e, t, b, g3 = proj.shape
+    h = g3 // 3
+    eb = e // E_BLK if e % E_BLK == 0 else 1
+    e_blk = e // eb
+    grid = (eb, t)
+    rev = lambda i, j: (i, t - 1 - j, 0, 0)  # walk time back-to-front
+    dproj, dw, db, dh0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((e_blk, 1, b, g3), rev),
+            pl.BlockSpec((e_blk, 1, b, h), rev),
+            pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
+            pl.BlockSpec((e_blk, 1, b, h), rev),
+        ],
+        out_specs=[
+            pl.BlockSpec((e_blk, 1, b, g3), rev),
+            pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
+            pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((e, t, b, g3), proj.dtype),
+            jax.ShapeDtypeStruct((e, h, g3), jnp.float32),
+            jax.ShapeDtypeStruct((e, g3), jnp.float32),
+            jax.ShapeDtypeStruct((e, b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((e_blk, b, h), jnp.float32),
+            pltpu.VMEM((e_blk, h, g3), jnp.float32),
+            pltpu.VMEM((e_blk, g3), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(proj, h_prev_all, w_hh, b_hh, dout)
+    return dproj, dw, db, dh0
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def gru_recurrence(proj, w_hh, b_hh, h0, interpret=False):
+    """Run the GRU time recurrence over pre-projected inputs.
+
+    Args:
+      proj: ``[E, T, B, 3H]`` — ``x @ W_ih + b_ih`` per expert (gate order
+        r, z, n along the last axis).
+      w_hh: ``[E, H, 3H]`` hidden-to-hidden weights.
+      b_hh: ``[E, 3H]`` hidden bias.
+      h0: ``[E, B, H]`` initial hidden state.
+      interpret: run the pallas kernels in interpret mode (CPU testing).
+
+    Returns: ``[E, T, B, H]`` float32 hidden states.
+    """
+    return _fwd_call(proj, w_hh, b_hh, h0, interpret)
+
+
+def _vjp_fwd(proj, w_hh, b_hh, h0, interpret):
+    h_all = _fwd_call(proj, w_hh, b_hh, h0, interpret)
+    return h_all, (proj, w_hh, b_hh, h0, h_all)
+
+
+def _vjp_bwd(interpret, res, dout):
+    proj, w_hh, b_hh, h0, h_all = res
+    h_prev_all = jnp.concatenate(
+        [h0[:, None].astype(h_all.dtype), h_all[:, :-1]], axis=1
+    )
+    dproj, dw, db, dh0 = _bwd_call(
+        proj, h_prev_all, w_hh, b_hh, dout.astype(jnp.float32), interpret
+    )
+    return (dproj, dw.astype(w_hh.dtype), db.astype(b_hh.dtype),
+            dh0.astype(h0.dtype))
+
+
+gru_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# padding helpers (shape hygiene for the kernel's tiling constraints)
+# ---------------------------------------------------------------------------
+
+
+def pad_batch(b: int) -> int:
+    """Round the batch up to the f32 sublane granularity."""
+    return int(np.ceil(b / _SUBLANE) * _SUBLANE)
+
+
+def supported(t: int, h: int) -> bool:
+    """Kernel preconditions: lane-aligned hidden size, non-trivial window."""
+    return h % 128 == 0 and t >= 1
