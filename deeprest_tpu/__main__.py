@@ -1,0 +1,7 @@
+"""``python -m deeprest_tpu`` — the pipeline CLI (see deeprest_tpu/cli.py)."""
+
+import sys
+
+from deeprest_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,343 @@
+"""Command-line drivers for the whole pipeline.
+
+The reference drives everything through bare scripts with constants edited
+in place (reference: resource-estimation/featurize.py:60, estimate.py:21,
+module constants at estimate.py:13-18; SURVEY.md §5.6).  Here each stage is
+a subcommand over the typed config:
+
+    python -m deeprest_tpu simulate   --scenario=normal --ticks=480 --out=raw.jsonl
+    python -m deeprest_tpu featurize  --raw=raw.jsonl --out=input.npz
+    python -m deeprest_tpu train      --features=input.npz --ckpt-dir=ckpt --plots-dir=plots
+    python -m deeprest_tpu synthesize --features=input.npz --mix='{"gateway /compose": 40}' --ticks=120
+    python -m deeprest_tpu predict    --ckpt-dir=ckpt --features=input.npz --out=preds.npz
+    python -m deeprest_tpu anomaly    --ckpt-dir=ckpt --features=input.npz
+
+``--raw`` accepts the reference pickle format (raw_data.pkl) or the
+framework's JSONL stream; ``simulate`` needs no cluster (it uses the
+in-process workload simulator — use ``python -m deeprest_tpu.loadgen`` to
+capture a corpus from the real native app instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+
+# -- shared loaders ---------------------------------------------------------
+
+
+def _load_buckets(path: str):
+    from deeprest_tpu.data.schema import iter_raw_data_jsonl, load_raw_data
+
+    if path.endswith((".jsonl", ".jsl")):
+        return list(iter_raw_data_jsonl(path))
+    return load_raw_data(path)
+
+
+def _load_features(args):
+    from deeprest_tpu.config import FeaturizeConfig
+    from deeprest_tpu.data.featurize import FeaturizedData, featurize_buckets
+
+    if getattr(args, "features", None):
+        return FeaturizedData.load(args.features)
+    cfg = FeaturizeConfig(
+        capacity=args.capacity, round_to=args.round_to,
+        hash_features=args.hash_features,
+    )
+    return featurize_buckets(_load_buckets(args.raw), cfg)
+
+
+def _add_input_args(p: argparse.ArgumentParser, features_ok: bool = True):
+    if features_ok:
+        p.add_argument("--features", default=None,
+                       help="featurized .npz (from the featurize subcommand)")
+    p.add_argument("--raw", default=None,
+                   help="raw corpus: reference pickle or JSONL stream")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="feature capacity (0 = size to observed, rounded)")
+    p.add_argument("--round-to", type=int, default=128)
+    p.add_argument("--hash-features", action="store_true",
+                   help="stable hash-bucketing instead of a grown vocabulary")
+
+
+def _require_input(args, features_ok: bool = True):
+    if getattr(args, "features", None) is None and args.raw is None:
+        sys.exit("error: provide --raw" + (" or --features" if features_ok else ""))
+
+
+# -- subcommands ------------------------------------------------------------
+
+
+def cmd_simulate(args) -> int:
+    from deeprest_tpu.data.schema import save_raw_data_jsonl, save_raw_data_pickle
+    from deeprest_tpu.workload.scenarios import SCENARIOS
+    from deeprest_tpu.workload.simulator import simulate_corpus
+
+    scenario = SCENARIOS[args.scenario](args.seed)
+    buckets = simulate_corpus(scenario, args.ticks)
+    if args.out.endswith((".jsonl", ".jsl")):
+        save_raw_data_jsonl(buckets, args.out)
+    else:
+        save_raw_data_pickle(buckets, args.out)
+    print(json.dumps({"scenario": args.scenario, "buckets": len(buckets),
+                      "out": args.out}))
+    return 0
+
+
+def _ensure_npz(path: str) -> str:
+    """np.savez appends '.npz' when missing — report the real filename."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def cmd_featurize(args) -> int:
+    _require_input(args, features_ok=False)
+    data = _load_features(args)
+    written = data.save(args.out)
+    print(json.dumps({
+        "out": written,
+        "buckets": int(data.traffic.shape[0]),
+        "capacity": int(data.traffic.shape[1]),
+        "observed_paths": data.space.num_observed,
+        "metrics": data.metric_names,
+    }))
+    return 0
+
+
+def cmd_train(args) -> int:
+    from deeprest_tpu.config import Config, ModelConfig, TrainConfig
+    from deeprest_tpu.models.baselines import baseline_predictions
+    from deeprest_tpu.train import Trainer, format_report, prepare_dataset
+
+    _require_input(args)
+    data = _load_features(args)
+    cfg = Config(
+        model=ModelConfig(hidden_size=args.hidden_size,
+                          dropout_rate=args.dropout,
+                          compute_dtype=args.compute_dtype),
+        train=TrainConfig(num_epochs=args.epochs, batch_size=args.batch_size,
+                          window_size=args.window, learning_rate=args.lr,
+                          train_split=args.split, seed=args.seed,
+                          eval_stride=args.window,
+                          checkpoint_dir=args.ckpt_dir or ""),
+    )
+    bundle = prepare_dataset(data, cfg.train)
+    baselines = None
+    if not args.no_baselines:
+        baselines = baseline_predictions(data, bundle)
+
+    trainer = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+
+    def on_epoch(result, state):
+        line = (f"epoch {result.epoch}: train {result.train_loss:.4f}"
+                + (f" test {result.test_loss:.4f}" if result.test_loss else ""))
+        print(line, flush=True)
+        if args.report_every and (result.epoch + 1) % args.report_every == 0:
+            print(format_report(result.report), flush=True)
+
+    state, history = trainer.fit(bundle, baseline_preds=baselines,
+                                 on_epoch=on_epoch)
+    print(format_report(history[-1].report))
+    print(f"steady-state throughput: {trainer.throughput.steps_per_sec:.2f} steps/s")
+
+    if args.plots_dir:
+        import os
+
+        from deeprest_tpu.train.data import eval_window_indices
+        from deeprest_tpu.train.plots import learning_curves, prediction_plots
+
+        learning_curves(history,
+                        os.path.join(args.plots_dir, "learning_curve.png"))
+        idx = eval_window_indices(len(bundle.x_test), cfg.train.eval_stride,
+                                  cfg.train.eval_max_cycles)
+        preds = trainer.predict(state, bundle.x_test[idx])   # [N, W, E, Q]
+        med = trainer.model.median_index()
+        denorm = lambda q: bundle.denorm_targets(
+            np.maximum(preds[..., q], 1e-6))
+        prediction_plots(
+            denorm(med), bundle.denorm_targets(bundle.y_test[idx]),
+            bundle.metric_names, args.plots_dir,
+            quantile_band=(denorm(0), denorm(preds.shape[-1] - 1)),
+        )
+        print(f"plots written to {args.plots_dir}")
+    return 0
+
+
+def cmd_synthesize(args) -> int:
+    from deeprest_tpu.data.synthesize import TraceSynthesizer
+
+    _require_input(args, features_ok=False)
+    buckets = _load_buckets(args.raw)
+    from deeprest_tpu.config import FeaturizeConfig
+    from deeprest_tpu.data.featurize import CallPathSpace
+
+    space = CallPathSpace(config=FeaturizeConfig(
+        capacity=args.capacity, round_to=args.round_to,
+        hash_features=args.hash_features))
+    synth = TraceSynthesizer(space).fit(buckets)
+    mix = json.loads(args.mix)
+    series = synth.synthesize_series([mix] * args.ticks, seed=args.seed)
+    out = _ensure_npz(args.out)
+    np.savez_compressed(out, traffic=series.astype(np.float32))
+    print(json.dumps({"out": out, "ticks": args.ticks,
+                      "endpoints": synth.endpoints,
+                      "capacity": int(space.capacity)}))
+    return 0
+
+
+def _predictor(args):
+    from deeprest_tpu.serve.predictor import Predictor
+
+    # model architecture comes from the checkpoint sidecar
+    return Predictor.from_checkpoint(args.ckpt_dir)
+
+
+def _serving_traffic(args, pred) -> np.ndarray:
+    """Traffic features for serving, column-exact with the checkpoint.
+
+    ``--features`` artifacts embed the space they were extracted with;
+    ``--raw`` corpora are featurized against the *checkpoint's* space (the
+    training vocabulary) — a freshly grown vocabulary could order columns
+    differently and silently permute the model input.
+    """
+    if args.features and not args.raw:
+        with np.load(_ensure_npz(args.features)) as z:
+            traffic = np.asarray(z["traffic"])
+    else:
+        space = pred.space()
+        if space is None:
+            sys.exit("error: checkpoint has no feature space; featurize the "
+                     "raw corpus with the training-time space and pass "
+                     "--features instead of --raw")
+        from deeprest_tpu.data.featurize import featurize_buckets
+
+        traffic = featurize_buckets(_load_buckets(args.raw),
+                                    space=space).traffic
+    if traffic.shape[1] != pred.model.config.feature_dim:
+        sys.exit(f"error: feature dim {traffic.shape[1]} != model "
+                 f"{pred.model.config.feature_dim}")
+    return traffic
+
+
+def cmd_predict(args) -> int:
+    _require_input(args)
+    pred = _predictor(args)
+    traffic = _serving_traffic(args, pred)
+    out_path = _ensure_npz(args.out)
+    out = pred.predict_series(traffic)                    # [T, E, Q]
+    np.savez_compressed(out_path, predictions=out,
+                        metric_names=np.array(pred.metric_names))
+    print(json.dumps({"out": out_path, "steps": int(out.shape[0]),
+                      "metrics": pred.metric_names}))
+    return 0
+
+
+def cmd_anomaly(args) -> int:
+    from deeprest_tpu.serve.anomaly import AnomalyDetector
+
+    _require_input(args)
+    pred = _predictor(args)
+    if args.features and not args.raw:
+        from deeprest_tpu.data.featurize import FeaturizedData
+
+        data = FeaturizedData.load(args.features)
+    else:
+        # featurize against the checkpoint's space for column exactness
+        space = pred.space()
+        if space is None:
+            sys.exit("error: checkpoint has no feature space; pass --features")
+        from deeprest_tpu.data.featurize import featurize_buckets
+
+        data = featurize_buckets(_load_buckets(args.raw), space=space)
+    if list(data.metric_names) != list(pred.metric_names):
+        sys.exit("error: corpus metrics do not match the checkpoint's")
+    detector = AnomalyDetector(pred, tolerance=args.tolerance,
+                               min_run=args.min_run)
+    reports = detector.check(data.traffic, data.targets())
+    for r in reports:
+        print(r)
+    flagged = [r.metric for r in reports if r.flagged]
+    print(json.dumps({"flagged": flagged}))
+    return 1 if flagged and args.fail_on_anomaly else 0
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="deeprest_tpu",
+        description="TPU-native API-aware resource estimation",
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("simulate", help="generate a raw corpus (no cluster)")
+    from deeprest_tpu.workload.scenarios import SCENARIOS
+
+    p.add_argument("--scenario", choices=sorted(SCENARIOS), default="normal")
+    p.add_argument("--ticks", type=int, default=480)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="raw_data.jsonl")
+    p.set_defaults(fn=cmd_simulate)
+
+    p = sub.add_parser("featurize", help="raw corpus → model-ready features")
+    _add_input_args(p, features_ok=False)
+    p.add_argument("--out", default="input.npz")
+    p.set_defaults(fn=cmd_featurize)
+
+    p = sub.add_parser("train", help="train + eval vs both baselines")
+    _add_input_args(p)
+    p.add_argument("--epochs", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--window", type=int, default=60)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--split", type=float, default=0.40)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--hidden-size", type=int, default=128)
+    p.add_argument("--dropout", type=float, default=0.5)
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--plots-dir", default=None)
+    p.add_argument("--report-every", type=int, default=0,
+                   help="print the full MAE table every N epochs (0 = end only)")
+    p.add_argument("--no-baselines", action="store_true")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("synthesize", help="what-if traffic feature synthesis")
+    _add_input_args(p, features_ok=False)
+    p.add_argument("--mix", required=True,
+                   help='JSON {endpoint: count} per time step')
+    p.add_argument("--ticks", type=int, default=60)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="synthetic.npz")
+    p.set_defaults(fn=cmd_synthesize)
+
+    p = sub.add_parser("predict", help="checkpoint + traffic → utilization")
+    _add_input_args(p)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", default="predictions.npz")
+    p.set_defaults(fn=cmd_predict)
+
+    p = sub.add_parser("anomaly", help="traffic-justified utilization check")
+    _add_input_args(p)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--tolerance", type=float, default=0.10)
+    p.add_argument("--min-run", type=int, default=5)
+    p.add_argument("--fail-on-anomaly", action="store_true")
+    p.set_defaults(fn=cmd_anomaly)
+
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

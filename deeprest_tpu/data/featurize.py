@@ -170,6 +170,23 @@ class CallPathSpace:
         """Root-level API endpoints (length-1 call paths) observed so far."""
         return [p[0] for p in self.vocabulary() if len(p) == 1]
 
+    # -- serialization -----------------------------------------------------
+
+    def to_dict(self) -> dict:
+        """JSON-safe state: config, column-ordered vocabulary, frozen width."""
+        return {
+            "config": dataclasses.asdict(self.config),
+            "vocabulary": [list(p) for p in self.vocabulary()],
+            "frozen_capacity": self.frozen_capacity,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CallPathSpace":
+        space = cls(config=FeaturizeConfig(**d["config"]))
+        space.index = {tuple(p): i for i, p in enumerate(d["vocabulary"])}
+        space.frozen_capacity = d["frozen_capacity"]
+        return space
+
 
 # --------------------------------------------------------------------------
 # Invocation counts (component-aware baseline input)
@@ -205,6 +222,54 @@ class FeaturizedData:
     def targets(self) -> np.ndarray:
         """[T, num_metrics] resource matrix in metric_names order."""
         return np.stack([self.resources[k] for k in self.metric_names], axis=-1)
+
+    def save(self, path: str) -> str:
+        """One-file ``.npz`` artifact — the typed replacement for the
+        reference's ``input.pkl`` (reference: featurize.py:104-106), with
+        the feature space included so downstream synthesis/serving stays
+        column-compatible by construction.  Returns the actual path written
+        (np.savez appends ``.npz`` when missing)."""
+        import json
+
+        if not path.endswith(".npz"):
+            path += ".npz"
+        np.savez_compressed(
+            path,
+            traffic=self.traffic,
+            resource_names=np.array(self.metric_names),
+            resource_values=self.targets(),
+            invocation_names=np.array(list(self.invocations)),
+            invocation_values=np.stack(
+                [self.invocations[k] for k in self.invocations], axis=-1
+            ) if self.invocations else np.zeros((len(self.traffic), 0)),
+            space_json=np.frombuffer(
+                json.dumps(self.space.to_dict()).encode(), dtype=np.uint8
+            ),
+        )
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "FeaturizedData":
+        import json
+        import os
+
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path += ".npz"
+        with np.load(path, allow_pickle=False) as z:
+            space = CallPathSpace.from_dict(
+                json.loads(bytes(z["space_json"]).decode())
+            )
+            resources = {
+                str(name): z["resource_values"][:, i].astype(np.float32)
+                for i, name in enumerate(z["resource_names"])
+            }
+            invocations = {
+                str(name): z["invocation_values"][:, i].astype(np.float32)
+                for i, name in enumerate(z["invocation_names"])
+            }
+            return cls(traffic=z["traffic"].astype(np.float32),
+                       resources=resources, invocations=invocations,
+                       space=space)
 
 
 def featurize_buckets(

@@ -154,3 +154,33 @@ class ComponentAwareBaseline:
 
         windows = np.asarray([ts_hat[i - w:i] for i in range(w, len(ts) + 1)])
         return windows[self.split:][:, :, None]
+
+
+def baseline_predictions(data, bundle, resource_epochs: int = 100) -> dict[str, np.ndarray]:
+    """Both baselines on every metric, aligned with ``bundle``'s test windows.
+
+    Returns ``{"resrc"|"comp": [N_test, W, E]}`` de-normalized predictions —
+    the two comparison columns of the reference's per-epoch eval table
+    (reference: estimate.py:31-39,112-122).
+    """
+    from deeprest_tpu.data.windows import sliding_windows
+
+    w = bundle.window_size
+    targets = data.targets()
+    resrc, comp = [], []
+    for idx, name in enumerate(bundle.metric_names):
+        y_m = sliding_windows(targets[:, [idx]], w)     # [N, W, 1] raw scale
+        component = name.rsplit("_", 1)[0]
+        resrc.append(
+            ResourceAwareBaseline(split=bundle.split, window_size=w,
+                                  num_epochs=resource_epochs).fit_and_estimate(y_m)
+        )
+        comp.append(
+            ComponentAwareBaseline(split=bundle.split, window_size=w,
+                                   component=component,
+                                   invocations=data.invocations).fit_and_estimate(y_m)
+        )
+    return {
+        "resrc": np.concatenate(resrc, axis=-1),
+        "comp": np.concatenate(comp, axis=-1),
+    }

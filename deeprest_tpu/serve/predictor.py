@@ -23,13 +23,17 @@ class Predictor:
 
     def __init__(self, params, model_config: ModelConfig,
                  x_stats: MinMaxStats, y_stats: MinMaxStats,
-                 metric_names: list[str], window_size: int):
+                 metric_names: list[str], window_size: int,
+                 space_dict: dict | None = None):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
         self.y_stats = y_stats
         self.metric_names = list(metric_names)
         self.window_size = window_size
+        # serialized CallPathSpace of the training corpus (if checkpointed):
+        # lets consumers featurize raw traces column-exactly — see space()
+        self.space_dict = space_dict
         self._apply = jax.jit(
             lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
         )
@@ -37,16 +41,24 @@ class Predictor:
     # ------------------------------------------------------------------
 
     @classmethod
-    def from_checkpoint(cls, directory: str, config: Config,
+    def from_checkpoint(cls, directory: str, config: Config | None = None,
                         step: int | None = None) -> "Predictor":
-        """Restore params + host stats written by Trainer.save()."""
-        from deeprest_tpu.train.checkpoint import restore_checkpoint
-        from deeprest_tpu.train.trainer import Trainer
+        """Restore params + host stats written by Trainer.save().
 
+        With ``config=None`` the architecture comes wholesale from the
+        checkpoint sidecar (all checkpoints written by Trainer.save carry
+        it), so the restored predictor cannot drift from training.  An
+        explicitly passed config is trusted as-is — the caller owns both
+        architecture and serving knobs (compute_dtype, rnn_backend).
+        """
+        import dataclasses as dc
         import json
         import os
 
-        from deeprest_tpu.train.checkpoint import latest_step, _step_dir, _SIDECAR
+        from deeprest_tpu.train.checkpoint import (
+            _SIDECAR, _step_dir, latest_step, restore_checkpoint,
+        )
+        from deeprest_tpu.train.trainer import Trainer
 
         if step is None:
             step = latest_step(directory)
@@ -55,6 +67,16 @@ class Predictor:
         with open(os.path.join(_step_dir(directory, step), _SIDECAR),
                   encoding="utf-8") as f:
             extra = json.load(f)
+
+        if config is None:
+            if "model_config" not in extra:
+                raise ValueError(
+                    f"checkpoint {directory!r} predates sidecar model configs; "
+                    "pass the architecture explicitly via `config`"
+                )
+            mc = dict(extra["model_config"])
+            mc["quantiles"] = tuple(mc.get("quantiles", ()))
+            config = Config(model=ModelConfig(**mc))
 
         metric_names = extra["metric_names"]
         trainer = Trainer(config, extra["feature_dim"], metric_names)
@@ -69,7 +91,17 @@ class Predictor:
             y_stats=MinMaxStats.from_dict(extra["y_stats"]),
             metric_names=metric_names,
             window_size=extra["window_size"],
+            space_dict=extra.get("space"),
         )
+
+    def space(self):
+        """The training corpus's CallPathSpace (column-exact featurization
+        for raw serve-time traces); None for pre-sidecar checkpoints."""
+        if self.space_dict is None:
+            return None
+        from deeprest_tpu.data.featurize import CallPathSpace
+
+        return CallPathSpace.from_dict(self.space_dict)
 
     # ------------------------------------------------------------------
 

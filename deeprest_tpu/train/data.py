@@ -31,6 +31,10 @@ class DatasetBundle:
     metric_names: list[str]
     split: int                 # number of train windows
     window_size: int
+    # Serialized CallPathSpace of the corpus (featurize.py to_dict): rides
+    # into the checkpoint sidecar so serving-time featurization of raw
+    # corpora is column-exact with the trained features.
+    space_dict: dict | None = None
 
     @property
     def num_metrics(self) -> int:
@@ -71,6 +75,7 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
         metric_names=list(data.metric_names),
         split=split,
         window_size=w,
+        space_dict=data.space.to_dict(),
     )
 
 

@@ -245,6 +245,8 @@ class Trainer:
             "y_stats": bundle.y_stats.to_dict(),
             "window_size": bundle.window_size,
             "feature_dim": bundle.feature_dim,
+            "model_config": dataclasses.asdict(self.model_config),
+            "space": bundle.space_dict,
         }
         return save_checkpoint(directory, state, int(state.step), extra)
 
