@@ -8,7 +8,7 @@ a subcommand over the typed config:
     python -m deeprest_tpu simulate   --scenario=normal --ticks=480 --out=raw.jsonl
     python -m deeprest_tpu featurize  --raw=raw.jsonl --out=input.npz
     python -m deeprest_tpu train      --features=input.npz --ckpt-dir=ckpt --plots-dir=plots
-    python -m deeprest_tpu synthesize --features=input.npz --mix='{"gateway /compose": 40}' --ticks=120
+    python -m deeprest_tpu synthesize --raw=raw.jsonl --mix='{"gateway /compose": 40}' --ticks=120
     python -m deeprest_tpu predict    --ckpt-dir=ckpt --features=input.npz --out=preds.npz
     python -m deeprest_tpu anomaly    --ckpt-dir=ckpt --features=input.npz
 
@@ -199,14 +199,23 @@ def _predictor(args):
 def _serving_traffic(args, pred) -> np.ndarray:
     """Traffic features for serving, column-exact with the checkpoint.
 
-    ``--features`` artifacts embed the space they were extracted with;
-    ``--raw`` corpora are featurized against the *checkpoint's* space (the
-    training vocabulary) — a freshly grown vocabulary could order columns
-    differently and silently permute the model input.
+    ``--features`` artifacts embed the space they were extracted with,
+    which must equal the checkpoint's (matching width alone would let a
+    permuted vocabulary through); ``--raw`` corpora are featurized against
+    the *checkpoint's* space (the training vocabulary) directly.
     """
     if args.features and not args.raw:
         with np.load(_ensure_npz(args.features)) as z:
             traffic = np.asarray(z["traffic"])
+            space_json = (bytes(z["space_json"]).decode()
+                          if "space_json" in z else None)
+        if space_json is not None and pred.space_dict is not None:
+            embedded = json.loads(space_json)
+            if embedded["vocabulary"] != pred.space_dict["vocabulary"]:
+                sys.exit("error: the features file was extracted with a "
+                         "different call-path vocabulary than the checkpoint "
+                         "was trained on; re-featurize the raw corpus with "
+                         "--raw (uses the checkpoint's space)")
     else:
         space = pred.space()
         if space is None:
@@ -255,6 +264,9 @@ def cmd_anomaly(args) -> int:
         data = featurize_buckets(_load_buckets(args.raw), space=space)
     if list(data.metric_names) != list(pred.metric_names):
         sys.exit("error: corpus metrics do not match the checkpoint's")
+    if data.traffic.shape[1] != pred.model.config.feature_dim:
+        sys.exit(f"error: feature dim {data.traffic.shape[1]} != model "
+                 f"{pred.model.config.feature_dim}")
     detector = AnomalyDetector(pred, tolerance=args.tolerance,
                                min_run=args.min_run)
     reports = detector.check(data.traffic, data.targets())

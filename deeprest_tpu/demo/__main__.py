@@ -1,0 +1,78 @@
+"""Demo CLI.
+
+    python -m deeprest_tpu.demo precompute --raw=corpus.jsonl \\
+        --ckpt-dir=ckpt --out=results.json.gz [--ticks=120] [--quick]
+    python -m deeprest_tpu.demo serve --results=results.json.gz --port=2021
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def cmd_precompute(args) -> int:
+    from deeprest_tpu.cli import _load_buckets
+    from deeprest_tpu.data.featurize import featurize_buckets
+    from deeprest_tpu.demo.precompute import (
+        DemoConfig, precompute_results, save_results,
+    )
+    from deeprest_tpu.serve.predictor import Predictor
+
+    predictor = Predictor.from_checkpoint(args.ckpt_dir)
+    space = predictor.space()
+    if space is None:
+        sys.exit("error: checkpoint has no feature space; re-train first")
+    buckets = _load_buckets(args.raw)
+    observed = featurize_buckets(buckets, space=space)
+
+    kwargs = {"ticks": args.ticks}
+    if args.quick:   # small grid for smoke runs
+        kwargs.update(shapes=("waves",), multipliers=(1, 3))
+    cfg = DemoConfig(**kwargs)
+    results = precompute_results(predictor, observed, buckets, cfg)
+    path = save_results(results, args.out)
+    print(f"wrote {len(results['datasets'])} datasets -> {path}")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from deeprest_tpu.demo.results import ResultsStore
+    from deeprest_tpu.demo.server import DemoServer
+
+    store = ResultsStore.load(args.results)
+    server = DemoServer(store, host=args.host, port=args.port)
+    host, port = server.address
+    print(f"demo at http://{host}:{port}/ "
+          f"({len(store.datasets)} datasets)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="deeprest_tpu.demo")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("precompute", help="build the results artifact")
+    p.add_argument("--raw", required=True, help="observed training corpus")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", default="results.json.gz")
+    p.add_argument("--ticks", type=int, default=120)
+    p.add_argument("--quick", action="store_true")
+    p.set_defaults(fn=cmd_precompute)
+
+    p = sub.add_parser("serve", help="serve the demo UI")
+    p.add_argument("--results", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2021)
+    p.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

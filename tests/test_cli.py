@@ -123,6 +123,21 @@ def test_predict_raw_uses_checkpoint_space(pipeline):
         assert z["predictions"].shape == (25, len(pred.metric_names), 3)
 
 
+def test_predict_rejects_mismatched_vocabulary(pipeline, tmp_path):
+    """--features extracted with a different vocabulary (same width) must be
+    rejected, not silently fed to the model with permuted columns."""
+    raw2 = str(tmp_path / "raw2.jsonl")
+    feats2 = str(tmp_path / "feats2.npz")
+    assert main(["simulate", "--scenario=composition", "--ticks=30", "--seed=3",
+                 f"--out={raw2}"]) == 0
+    # same round-to → same capacity, different observation order
+    assert main(["featurize", f"--raw={raw2}", f"--out={feats2}",
+                 "--round-to=8"]) == 0
+    with pytest.raises(SystemExit, match="vocabulary"):
+        main(["predict", f"--features={feats2}",
+              f"--ckpt-dir={pipeline['ckpt']}", "--out=x.npz"])
+
+
 def test_featurize_out_without_extension(tmp_path):
     raw = str(tmp_path / "raw.jsonl")
     assert main(["simulate", "--ticks=5", f"--out={raw}"]) == 0

@@ -1,0 +1,126 @@
+"""Demo plane: precompute artifact, results store, HTTP server contract
+(SURVEY.md §2.4 — the reference's web-demo capability)."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.cli import main as cli_main
+from deeprest_tpu.data.schema import iter_raw_data_jsonl
+from deeprest_tpu.demo.precompute import (
+    DemoConfig, dataset_name, precompute_results, save_results,
+)
+from deeprest_tpu.demo.results import ResultsStore
+from deeprest_tpu.demo.server import DemoServer
+from deeprest_tpu.serve.predictor import Predictor
+
+TICKS = 30
+WINDOW = 12
+
+
+@pytest.fixture(scope="module")
+def demo_results(tmp_path_factory):
+    root = tmp_path_factory.mktemp("demo")
+    raw = str(root / "raw.jsonl")
+    ckpt = str(root / "ckpt")
+    assert cli_main(["simulate", "--scenario=normal", "--ticks=120",
+                     f"--out={raw}"]) == 0
+    assert cli_main(["train", f"--raw={raw}", "--epochs=1", "--batch-size=16",
+                     f"--window={WINDOW}", "--hidden-size=8", "--dropout=0.1",
+                     "--no-baselines", f"--ckpt-dir={ckpt}",
+                     "--round-to=8"]) == 0
+
+    predictor = Predictor.from_checkpoint(ckpt)
+    buckets = list(iter_raw_data_jsonl(raw))
+    from deeprest_tpu.data.featurize import featurize_buckets
+
+    observed = featurize_buckets(buckets, space=predictor.space())
+    cfg = DemoConfig(shapes=("waves", "flat"), multipliers=(1, 2),
+                     seen=((0.2, 0.5, 0.25), (0.3, 0.4, 0.25)),
+                     unseen=((0.6, 0.2, 0.15),), ticks=TICKS,
+                     components=("nginx-thrift", "post-storage-mongodb"))
+    results = precompute_results(predictor, observed, buckets, cfg)
+    path = save_results(results, str(root / "results.json.gz"))
+    return {"results": results, "path": path, "cfg": cfg}
+
+
+def test_dataset_grid(demo_results):
+    ds = demo_results["results"]["datasets"]
+    # waves: 2 mult x (2 seen + 1 unseen); flat: 1x seen only
+    assert set(ds) == {
+        dataset_name("waves", 1, "seen", 0), dataset_name("waves", 1, "seen", 1),
+        dataset_name("waves", 1, "unseen", 0),
+        dataset_name("waves", 2, "seen", 0), dataset_name("waves", 2, "seen", 1),
+        dataset_name("waves", 2, "unseen", 0),
+        dataset_name("flat", 1, "seen", 0), dataset_name("flat", 1, "seen", 1),
+    }
+
+
+def test_record_contents(demo_results):
+    ds = demo_results["results"]["datasets"][dataset_name("waves", 2, "seen", 0)]
+    assert set(ds["components"]) == {"nginx-thrift", "post-storage-mongodb"}
+    rec = ds["components"]["nginx-thrift"]["cpu"]
+    for series in ("groundtruth", "ours", "ours_lo", "ours_hi", "resrc", "comp"):
+        assert len(rec[series]) == TICKS
+        assert all(np.isfinite(rec[series]))
+    assert set(rec["scale"]) == {"groundtruth", "ours", "resrc", "comp"}
+    calls = ds["calls"]
+    assert all(len(v) == TICKS for v in calls.values())
+    # 2x multiplier roughly doubles total calls vs 1x
+    ds1 = demo_results["results"]["datasets"][dataset_name("waves", 1, "seen", 0)]
+    total2 = sum(sum(v) for v in calls.values())
+    total1 = sum(sum(v) for v in ds1["calls"].values())
+    assert 1.5 < total2 / total1 < 2.6
+
+
+def test_memory_reanchored(demo_results):
+    """memory/usage series are re-anchored to the observed last value."""
+    ds = demo_results["results"]["datasets"][dataset_name("waves", 1, "seen", 0)]
+    rec = ds["components"]["nginx-thrift"]["memory"]
+    anchors = {rec[s][0] for s in ("groundtruth", "ours", "resrc", "comp")}
+    assert len({round(a, 3) for a in anchors}) == 1
+
+
+def test_store_roundtrip_and_options(demo_results):
+    store = ResultsStore.load(demo_results["path"])
+    assert store.options_multiplier("waves") == [1, 2]
+    assert store.options_multiplier("flat") == [1]
+    comps = store.options_composition("flat")
+    assert "unseen" not in comps
+    panel = store.panel("waves", 2, "unseen", 0)
+    assert panel["methods"] == ["groundtruth", "resrc", "comp", "ours"]
+    rec = panel["components"]["nginx-thrift"]["cpu"]
+    assert len(rec["scale"]) == 4
+    assert len(rec["band"]["lo"]) == TICKS
+
+
+def test_http_server_contract(demo_results):
+    store = ResultsStore.load(demo_results["path"])
+    server = DemoServer(store, port=0).start_background()
+    host, port = server.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/")
+        page = conn.getresponse()
+        assert page.status == 200
+        assert b"what-if" in page.read()
+
+        conn.request("GET", "/api/meta")
+        meta = json.loads(conn.getresponse().read())
+        assert meta["multipliers"]["waves"] == [1, 2]
+
+        conn.request("GET", "/api/panel?shape=waves&multiplier=1&group=seen&index=1")
+        panel = json.loads(conn.getresponse().read())
+        assert panel["composition"] == [0.3, 0.4, 0.25]
+
+        conn.request("GET", "/api/panel?shape=waves&multiplier=9&group=seen&index=0")
+        err = conn.getresponse()
+        assert err.status == 400
+        assert "no dataset" in json.loads(err.read())["error"]
+
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+    finally:
+        server.stop()
