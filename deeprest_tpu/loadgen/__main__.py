@@ -7,6 +7,16 @@ in one command:
 
     python -m deeprest_tpu.loadgen --scenario=normal --ticks=30 \\
         --tick-seconds=2 --out=raw_data.jsonl
+
+With ``--target`` the supervisor is skipped and an already-running plane
+(e.g. the k8s deployment from deploy/) is driven through its gateway — the
+locust-against-a-cluster role (reference: locust/README.md:23-33); the
+deployed trace collector writes the corpus on its side:
+
+    python -m deeprest_tpu.loadgen --scenario=normal --ticks=480 \\
+        --target=nginx-thrift.deeprest-sns.svc.cluster.local:9090 \\
+        --media=media-frontend.deeprest-sns.svc.cluster.local:9090 \\
+        --collector=trace-collector.deeprest-sns.svc.cluster.local:9090
 """
 
 from __future__ import annotations
@@ -24,6 +34,13 @@ from deeprest_tpu.loadgen.warmup import warmup
 from deeprest_tpu.workload.scenarios import SCENARIOS
 
 
+def _addr(spec: str) -> tuple[str, int]:
+    host, _, port = spec.rpartition(":")
+    if not host:
+        raise argparse.ArgumentTypeError(f"{spec!r} is not host:port")
+    return host, int(port)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="deeprest_tpu.loadgen")
     ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="normal")
@@ -32,6 +49,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--interval-ms", type=int, default=None,
                     help="collector bucket length (default: tick length)")
     ap.add_argument("--out", default="raw_data.jsonl")
+    ap.add_argument("--target", type=_addr, default=None, metavar="HOST:PORT",
+                    help="drive an existing gateway instead of booting a cluster")
+    ap.add_argument("--media", type=_addr, default=None, metavar="HOST:PORT",
+                    help="media-frontend of the existing plane (with --target)")
+    ap.add_argument("--collector", type=_addr, default=None, metavar="HOST:PORT",
+                    help="trace collector of the existing plane (crypto burner "
+                         "registration; with --target)")
     ap.add_argument("--users", type=int, default=96, help="graph population")
     ap.add_argument("--user-scale", type=float, default=0.1,
                     help="scales the scenario user curve to local capacity")
@@ -47,17 +71,15 @@ def main(argv: list[str] | None = None) -> int:
     graph = synthetic_social_graph(args.users, seed=args.seed)
     interval = args.interval_ms or int(args.tick_seconds * 1000)
 
-    with SnsCluster(out_path=args.out, interval_ms=interval,
-                    verbose=args.verbose) as cluster:
-        print(f"cluster up; gateway {cluster.gateway_addr}", file=sys.stderr)
-        stats = warmup(*cluster.gateway_addr, graph)
+    def drive(gateway_addr, media_addr, collector_addr):
+        stats = warmup(*gateway_addr, graph)
         print(f"warmup: {stats}", file=sys.stderr)
         runner = LoadRunner(
-            cluster.gateway_addr, graph, scenario,
+            gateway_addr, graph, scenario,
             RunnerConfig(tick_seconds=args.tick_seconds,
                          think_time=(args.think_min, args.think_max),
                          user_scale=args.user_scale, seed=args.seed),
-            media_addr=cluster.media_addr,
+            media_addr=media_addr,
         )
         burner = None
         timer = None
@@ -66,18 +88,32 @@ def main(argv: list[str] | None = None) -> int:
             # buckets on both sides, like the reference's mid-experiment
             # injection
             burner = Burner(args.ticks * args.tick_seconds / 2,
-                            collector_addr=cluster.collector_addr,
+                            collector_addr=collector_addr,
                             component=args.burn_component)
             timer = threading.Timer(args.ticks * args.tick_seconds / 4,
                                     burner.start)
             timer.start()
         try:
-            run_stats = runner.run(args.ticks)
+            return runner.run(args.ticks)
         finally:
             if timer is not None:
                 timer.cancel()
             if burner is not None:
                 burner.stop()
+
+    if args.target is not None:
+        # drive an already-running plane; its collector owns the corpus
+        print(f"driving existing gateway {args.target}", file=sys.stderr)
+        run_stats = drive(args.target, args.media, args.collector)
+        print(json.dumps({"scenario": args.scenario, "target": list(args.target),
+                          **run_stats}))
+        return 0
+
+    with SnsCluster(out_path=args.out, interval_ms=interval,
+                    verbose=args.verbose) as cluster:
+        print(f"cluster up; gateway {cluster.gateway_addr}", file=sys.stderr)
+        run_stats = drive(cluster.gateway_addr, cluster.media_addr,
+                          cluster.collector_addr)
         cluster.stop(drain_s=1.5)
     print(json.dumps({"scenario": args.scenario, "out": args.out, **run_stats}))
     return 0

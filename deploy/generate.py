@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Kubernetes manifest generator for the native app plane.
+
+The reference maintains 31 hand-written Service+Deployment YAMLs plus PVC
+init and tracing configs (reference: social-network/social-network-deploy/
+k8s-yaml/ — SURVEY.md §2.2); here one generator is the source of truth and
+the manifests under deploy/k8s/ are its committed output:
+
+    python deploy/generate.py --out=deploy/k8s [--image=deeprest-sns:latest]
+
+Layout decisions mirrored from the reference deployment:
+- one Deployment+Service per component (12 services, 13 datastores, 2
+  gateways, the queue consumer, the trace collector);
+- stateful stores mount a PersistentVolumeClaim so per-PVC metrics exist
+  to predict (reference: user-timeline-mongodb.yaml:50-56; the OpenEBS
+  cStor role — SURVEY.md L0);
+- pod labels encode the dataflow graph (INPUTn:/OUTPUTn: labels,
+  reference: nginx-thrift.yaml:44-51) so mesh/CNI policy tooling can read
+  the topology;
+- the gateway Service is a NodePort at 31000 (reference:
+  nginx-thrift.yaml:11-16), 3 replicas;
+- the collector plays the Jaeger+Prometheus role: every pod registers
+  with it, and it exports the raw-data corpus on a PVC.
+
+The cluster config the binary consumes (component → host:port) becomes a
+ConfigMap of k8s DNS names — service discovery via kube-dns instead of the
+reference's hand-edited service-config.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeprest_tpu.loadgen.cluster import (  # noqa: E402
+    COLLECTOR, CONSUMER, GATEWAYS, SERVICES, STORES,
+)
+
+NAMESPACE = "deeprest-sns"
+PORT = 9090
+GATEWAY_NODEPORT = 31000
+
+# Dataflow edges (who calls whom) for the INPUT/OUTPUT pod labels; derived
+# from the call stacks in SURVEY.md §3.1-3.2.
+EDGES: dict[str, tuple[str, ...]] = {
+    "nginx-thrift": ("user-service", "media-service", "text-service",
+                     "unique-id-service", "home-timeline-service",
+                     "user-timeline-service", "social-graph-service"),
+    "media-frontend": ("media-mongodb",),
+    "compose-post-service": ("compose-post-redis", "post-storage-service",
+                             "user-timeline-service", "rabbitmq"),
+    "unique-id-service": ("compose-post-service",),
+    "media-service": ("compose-post-service",),
+    "text-service": ("url-shorten-service", "user-mention-service",
+                     "compose-post-service"),
+    "url-shorten-service": ("url-shorten-mongodb", "compose-post-service"),
+    "user-mention-service": ("user-memcached", "user-mongodb",
+                             "compose-post-service"),
+    "user-service": ("user-memcached", "user-mongodb",
+                     "compose-post-service", "social-graph-service"),
+    "social-graph-service": ("social-graph-redis", "social-graph-mongodb",
+                             "user-service"),
+    "post-storage-service": ("post-storage-memcached", "post-storage-mongodb"),
+    "user-timeline-service": ("user-timeline-redis", "user-timeline-mongodb",
+                              "post-storage-service"),
+    "home-timeline-service": ("home-timeline-redis", "post-storage-service"),
+    "write-home-timeline-service": ("rabbitmq", "home-timeline-redis",
+                                    "social-graph-service"),
+}
+
+# Every store persists (so per-PVC metrics exist to predict — the OpenEBS
+# rationale, minikube-openebs/README.md:2); rabbitmq included: its queue
+# survives pod restarts like the reference's durable deployment.
+STATEFUL = STORES
+
+# Reverse edges for the INPUTn labels, derived once from EDGES.
+INPUTS: dict[str, tuple[str, ...]] = {}
+
+
+def _build_inputs() -> None:
+    rev: dict[str, list[str]] = {}
+    for src, dsts in EDGES.items():
+        for dst in dsts:
+            rev.setdefault(dst, []).append(src)
+    INPUTS.update({k: tuple(v) for k, v in rev.items()})
+
+
+_build_inputs()
+
+
+def _meta(name: str, extra_labels: dict | None = None) -> dict:
+    labels = {"app": name, "plane": "deeprest-sns"}
+    if extra_labels:
+        labels.update(extra_labels)
+    return {"name": name, "namespace": NAMESPACE, "labels": labels}
+
+
+def namespace() -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": NAMESPACE}}
+
+
+def cluster_configmap() -> dict:
+    components = {
+        c: {"host": f"{c}.{NAMESPACE}.svc.cluster.local", "port": PORT}
+        for c in (*STORES, *SERVICES, *GATEWAYS, CONSUMER, COLLECTOR)
+    }
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta("cluster-config"),
+        "data": {"cluster.json": json.dumps({"components": components},
+                                            indent=2)},
+    }
+
+
+def pvc(name: str, size: str = "2Gi") -> dict:
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": _meta(f"{name}-pvc"),
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": size}}},
+    }
+
+
+def service(name: str, nodeport: int | None = None) -> dict:
+    spec: dict = {
+        "selector": {"app": name},
+        "ports": [{"name": "rpc", "port": PORT, "targetPort": PORT}],
+    }
+    if nodeport is not None:
+        spec["type"] = "NodePort"
+        spec["ports"][0]["nodePort"] = nodeport
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(name), "spec": spec}
+
+
+def deployment(name: str, image: str, replicas: int = 1,
+               extra_args: list[str] | None = None,
+               with_pvc: bool = False) -> dict:
+    labels = {f"OUTPUT{i + 1}": dst
+              for i, dst in enumerate(EDGES.get(name, ()))}
+    labels.update({f"INPUT{i + 1}": src
+                   for i, src in enumerate(INPUTS.get(name, ()))})
+    args = [f"--service={name}", "--config=/etc/deeprest/cluster.json"]
+    args += extra_args or []
+    volumes = [{"name": "config",
+                "configMap": {"name": "cluster-config"}}]
+    mounts = [{"name": "config", "mountPath": "/etc/deeprest"}]
+    if with_pvc:
+        volumes.append({"name": "data",
+                        "persistentVolumeClaim": {"claimName": f"{name}-pvc"}})
+        mounts.append({"name": "data", "mountPath": "/var/lib/deeprest"})
+    container = {
+        "name": name, "image": image,
+        "command": ["/usr/local/bin/snsd"], "args": args,
+        "ports": [{"containerPort": PORT}],
+        "volumeMounts": mounts,
+        "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}},
+    }
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta(name),
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name,
+                                        "plane": "deeprest-sns", **labels}},
+                "spec": {"containers": [container], "volumes": volumes,
+                         "restartPolicy": "Always"},
+            },
+        },
+    }
+
+
+def loadgen_job(image: str) -> dict:
+    """Drives the DEPLOYED plane through its gateway services (the locust
+    role, reference: locust/README.md:23-33); the deployed collector owns
+    the corpus on its own PVC, so the Job mounts nothing."""
+    dns = f"{NAMESPACE}.svc.cluster.local"
+    return {
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": _meta("loadgen"),
+        "spec": {"template": {"spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "loadgen", "image": image,
+                "command": ["python", "-m", "deeprest_tpu.loadgen"],
+                "args": ["--scenario=normal", "--ticks=480",
+                         "--tick-seconds=60",
+                         f"--target=nginx-thrift.{dns}:{PORT}",
+                         f"--media=media-frontend.{dns}:{PORT}",
+                         f"--collector={COLLECTOR}.{dns}:{PORT}"],
+            }],
+        }}},
+    }
+
+
+def generate(image: str) -> dict[str, list[dict]]:
+    """filename → list of manifest documents."""
+    files: dict[str, list[dict]] = {
+        "00-namespace.yaml": [namespace()],
+        "01-config.yaml": [cluster_configmap()],
+        "02-pvcs.yaml": [pvc(s) for s in (*STATEFUL, COLLECTOR)],
+    }
+    for store in STORES:
+        files[f"store-{store}.yaml"] = [
+            service(store), deployment(store, image, with_pvc=store in STATEFUL),
+        ]
+    for svc in SERVICES:
+        files[f"svc-{svc}.yaml"] = [service(svc), deployment(svc, image)]
+    files["gw-nginx-thrift.yaml"] = [
+        service("nginx-thrift", nodeport=GATEWAY_NODEPORT),
+        deployment("nginx-thrift", image, replicas=3),
+    ]
+    files["gw-media-frontend.yaml"] = [
+        service("media-frontend"), deployment("media-frontend", image),
+    ]
+    files["consumer.yaml"] = [service(CONSUMER), deployment(CONSUMER, image)]
+    files["collector.yaml"] = [
+        service(COLLECTOR),
+        deployment(COLLECTOR, image, with_pvc=True,
+                   extra_args=["--out=/var/lib/deeprest/raw_data.jsonl",
+                               "--interval-ms=5000"]),
+    ]
+    files["loadgen-job.yaml"] = [loadgen_job(image)]
+    return files
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "k8s"))
+    ap.add_argument("--image", default="deeprest-sns:latest")
+    args = ap.parse_args(argv)
+
+    import yaml
+
+    os.makedirs(args.out, exist_ok=True)
+    files = generate(args.image)
+    for fname, docs in files.items():
+        with open(os.path.join(args.out, fname), "w", encoding="utf-8") as f:
+            yaml.safe_dump_all(docs, f, sort_keys=False)
+    print(f"wrote {len(files)} manifest files -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Deployment manifest generator (SURVEY.md §2.2 deployment inventory):
+every component gets a Service+Deployment, stateful stores mount PVCs,
+labels encode the dataflow graph, and the committed deploy/k8s/ output is
+in sync with the generator."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "deploy"))
+import generate  # noqa: E402
+from deeprest_tpu.loadgen.cluster import (  # noqa: E402
+    COLLECTOR, CONSUMER, GATEWAYS, SERVICES, STORES,
+)
+
+FILES = generate.generate("img:test")
+ALL_DOCS = [d for docs in FILES.values() for d in docs]
+
+
+def _by_kind(kind):
+    return {d["metadata"]["name"]: d for d in ALL_DOCS if d["kind"] == kind}
+
+
+def test_every_component_has_service_and_deployment():
+    services = _by_kind("Service")
+    deployments = _by_kind("Deployment")
+    for comp in (*STORES, *SERVICES, *GATEWAYS, CONSUMER, COLLECTOR):
+        assert comp in services, f"missing Service for {comp}"
+        assert comp in deployments, f"missing Deployment for {comp}"
+        args = deployments[comp]["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert f"--service={comp}" in args
+
+
+def test_stateful_stores_mount_pvcs():
+    deployments = _by_kind("Deployment")
+    pvcs = _by_kind("PersistentVolumeClaim")
+    for store in STORES:
+        spec = deployments[store]["spec"]["template"]["spec"]
+        claim_vols = [v for v in spec["volumes"]
+                      if "persistentVolumeClaim" in v]
+        assert claim_vols, f"{store} has no PVC volume"
+        assert f"{store}-pvc" in pvcs
+    # the collector's corpus output also persists
+    assert f"{COLLECTOR}-pvc" in pvcs
+
+
+def test_gateway_shape():
+    deployments = _by_kind("Deployment")
+    services = _by_kind("Service")
+    assert deployments["nginx-thrift"]["spec"]["replicas"] == 3
+    svc = services["nginx-thrift"]["spec"]
+    assert svc["type"] == "NodePort"
+    assert svc["ports"][0]["nodePort"] == generate.GATEWAY_NODEPORT
+
+
+def test_dataflow_labels():
+    deployments = _by_kind("Deployment")
+    labels = deployments["compose-post-service"]["spec"]["template"]["metadata"]["labels"]
+    outputs = {v for k, v in labels.items() if k.startswith("OUTPUT")}
+    assert {"post-storage-service", "user-timeline-service",
+            "rabbitmq"} <= outputs
+    # INPUT labels are the reverse edges (reference encodes both directions)
+    inputs = {v for k, v in labels.items()
+              if k.startswith("INPUT")}
+    assert {"unique-id-service", "media-service", "text-service"} <= inputs
+    # every edge target is a real component
+    every = set(STORES) | set(SERVICES) | set(GATEWAYS) | {CONSUMER, COLLECTOR}
+    for src, dsts in generate.EDGES.items():
+        assert src in every
+        assert set(dsts) <= every, f"unknown edge target from {src}"
+
+
+def test_loadgen_job_drives_deployed_gateway():
+    """The Job must target the deployed Services, not boot a private
+    cluster, and needs no volume (the collector owns the corpus)."""
+    job = _by_kind("Job")["loadgen"]
+    spec = job["spec"]["template"]["spec"]
+    args = spec["containers"][0]["args"]
+    assert any(a.startswith("--target=nginx-thrift.") for a in args)
+    assert any(a.startswith(f"--collector={COLLECTOR}.") for a in args)
+    assert not any(a.startswith("--out") for a in args)
+    assert "volumes" not in spec
+
+
+def test_configmap_covers_all_components():
+    cm = _by_kind("ConfigMap")["cluster-config"]
+    import json
+
+    components = json.loads(cm["data"]["cluster.json"])["components"]
+    assert set(components) == set(STORES) | set(SERVICES) | set(GATEWAYS) | {
+        CONSUMER, COLLECTOR}
+    for c, ep in components.items():
+        assert ep["host"].startswith(f"{c}.{generate.NAMESPACE}.svc")
+
+
+def test_committed_manifests_in_sync(tmp_path):
+    """deploy/k8s/ must be regenerated whenever the generator changes."""
+    out = subprocess.run(
+        [sys.executable, os.path.join("deploy", "generate.py"),
+         f"--out={tmp_path}"],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.returncode == 0, out.stderr
+    repo_dir = os.path.join(os.path.dirname(__file__), "..", "deploy", "k8s")
+    fresh = sorted(os.path.basename(p) for p in glob.glob(str(tmp_path / "*.yaml")))
+    committed = sorted(os.path.basename(p)
+                       for p in glob.glob(os.path.join(repo_dir, "*.yaml")))
+    assert fresh == committed
+    for name in fresh:
+        with open(tmp_path / name) as f1, open(os.path.join(repo_dir, name)) as f2:
+            assert list(yaml.safe_load_all(f1)) == list(yaml.safe_load_all(f2)), (
+                f"{name} out of date: python deploy/generate.py")
