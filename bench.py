@@ -2,46 +2,83 @@
 """Headline benchmark: trainer steps/sec on the flagship configuration.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Configuration: the DeathStarBench-social-network scale from BASELINE.json
 config 2 — 40 metric experts (8 components x 5 resources), 512 call-path
 features, window 60, batch 32, hidden 128, bfloat16 matmuls.
 
+Resilience design (round-1 postmortem: one transient UNAVAILABLE at TPU
+backend init produced rc=1 and a lost round): the orchestrating process
+NEVER initializes a JAX backend itself.  All device work runs in child
+processes (`bench.py --measure`) with hard timeouts, so a hung backend init
+cannot hang the bench.  The TPU attempt is retried with backoff; if every
+attempt fails, the bench falls back to a CPU measurement and still emits a
+parseable JSON line (rc=0) carrying the TPU error for the record.
+
 ``vs_baseline`` is measured against the reference-equivalent PyTorch model
 (benchmarks/baseline_torch.py) on this host's CPU — the reference publishes
-no throughput numbers and no GPU is attached here (BASELINE.md); the torch
-number is cached in bench_baseline.json so repeated runs don't re-measure.
+no throughput numbers and no GPU is attached here (BASELINE.md).  That
+anchor is honest but weak (CPU torch vs TPU jax is not the A100 ratio the
+north star names), so the output labels it explicitly in ``anchor``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 B, T, F, E, H = 32, 60, 512, 40, 128
-WARMUP_STEPS = 5
-MEASURE_STEPS = 30
-TRIALS = 3
 BASELINE_CACHE = os.path.join(REPO, "bench_baseline.json")
 
+# TPU attempt schedule: the chip sits behind a shared tunnel that can be
+# transiently unavailable; init can also hang rather than fail.  A cheap
+# probe (backend init only) gates the expensive measurement so a hung
+# tunnel costs minutes, not the whole timeout budget.
+TPU_PROBE_ATTEMPTS = 3
+TPU_PROBE_TIMEOUT_S = 90
+TPU_BACKOFF_S = (10, 30)
+TPU_TIMEOUT_S = 420          # first compile is 20-40s; measurement ~1 min
+CPU_TIMEOUT_S = 2400         # flagship f32 CPU steps are ~7s each
 
-def measure_jax_steps_per_sec() -> tuple[float, str]:
+# Measurement sizes.  The CPU fallback uses fewer steps and f32 (bf16 is
+# software-emulated on CPU, ~60s/step): it is a sanity anchor, not the
+# headline, and its JSON labels the dtype honestly.
+FULL = {"warmup": 5, "steps": 30, "trials": 3, "dtype": "bfloat16"}
+LIGHT = {"warmup": 1, "steps": 3, "trials": 1, "dtype": "float32"}
+
+TORCH_STEPS, TORCH_WARMUP = 10, 2
+
+
+# ---------------------------------------------------------------------------
+# child: actually measure (runs with whatever backend the env selects)
+# ---------------------------------------------------------------------------
+
+
+def measure_main(light: bool, cpu: bool = False) -> None:
+    import numpy as np
+
     import jax
+
+    if cpu:
+        # The axon site hook re-registers the TPU platform regardless of the
+        # JAX_PLATFORMS env var; only the config knob reliably forces CPU
+        # (same reason tests/conftest.py does this).
+        jax.config.update("jax_platforms", "cpu")
 
     from deeprest_tpu.config import Config, ModelConfig, TrainConfig
     from deeprest_tpu.train import Trainer
 
+    sizes = LIGHT if light else FULL
     cfg = Config(
         model=ModelConfig(feature_dim=F, num_metrics=E, hidden_size=H,
-                          compute_dtype="bfloat16"),
+                          compute_dtype=sizes["dtype"]),
         train=TrainConfig(batch_size=B, window_size=T),
     )
     metric_names = [f"comp{i // 5}_res{i % 5}" for i in range(E)]
@@ -53,58 +90,179 @@ def measure_jax_steps_per_sec() -> tuple[float, str]:
     w = np.ones((B,), np.float32)
 
     state = trainer.init_state(x)
-    xb, yb, wb = (np.asarray(a) for a in (x, y, w))
-    for _ in range(WARMUP_STEPS):
-        state, loss = trainer._train_step(state, xb, yb, wb)
+    for _ in range(sizes["warmup"]):
+        state, loss = trainer._train_step(state, x, y, w)
     jax.block_until_ready(state.params)
 
     # The chip is reached through a shared tunnel with visible run-to-run
     # variance; take the best of a few trials as the steady-state figure.
     best = 0.0
-    for _ in range(TRIALS):
+    for _ in range(sizes["trials"]):
         t0 = time.perf_counter()
-        for _ in range(MEASURE_STEPS):
-            state, loss = trainer._train_step(state, xb, yb, wb)
+        for _ in range(sizes["steps"]):
+            state, loss = trainer._train_step(state, x, y, w)
         jax.block_until_ready(state.params)
-        best = max(best, MEASURE_STEPS / (time.perf_counter() - t0))
+        best = max(best, sizes["steps"] / (time.perf_counter() - t0))
     if not np.isfinite(float(loss)):
         raise RuntimeError(f"non-finite bench loss {loss}")
-    platform = jax.devices()[0].platform
-    return best, platform
+    print(json.dumps({
+        "steps_per_sec": best,
+        "platform": jax.devices()[0].platform,
+        "dtype": sizes["dtype"],
+    }))
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate child processes, never touch a backend
+# ---------------------------------------------------------------------------
+
+
+def _run_child(extra_args: list[str], env_overrides: dict[str, str],
+               timeout_s: float) -> dict:
+    env = dict(os.environ)
+    env.update(env_overrides)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--measure", *extra_args],
+        capture_output=True, text=True, timeout=timeout_s, env=env, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        raise RuntimeError(" | ".join(tail) or f"rc={proc.returncode}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError("child produced no JSON line")
+
+
+def _measure_with_fallback() -> tuple[dict, str | None]:
+    """Returns (measurement dict, tpu_error-or-None)."""
+    tpu_error = None
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        for attempt in range(TPU_PROBE_ATTEMPTS):
+            try:
+                probe = _run_child(["--probe"], {}, TPU_PROBE_TIMEOUT_S)
+                if probe.get("platform") == "cpu":
+                    # jax fell back to CPU silently: running the FULL bf16
+                    # config there would just burn the measurement timeout.
+                    tpu_error = "probe resolved to cpu platform (no accelerator)"
+                    print(f"bench: {tpu_error}", file=sys.stderr)
+                    probe = None
+                    break
+                print(f"bench: device probe ok: {probe}", file=sys.stderr)
+                break
+            except subprocess.TimeoutExpired:
+                tpu_error = (f"device probe {attempt + 1} timed out after "
+                             f"{TPU_PROBE_TIMEOUT_S}s")
+            except (RuntimeError, OSError) as exc:
+                tpu_error = f"device probe {attempt + 1}: {exc}"
+            print(f"bench: {tpu_error}", file=sys.stderr)
+            probe = None
+            if attempt < TPU_PROBE_ATTEMPTS - 1:
+                time.sleep(TPU_BACKOFF_S[min(attempt, len(TPU_BACKOFF_S) - 1)])
+        else:
+            probe = None
+        if probe is not None:
+            for attempt in range(2):
+                try:
+                    return _run_child([], {}, TPU_TIMEOUT_S), None
+                except subprocess.TimeoutExpired:
+                    tpu_error = f"measurement timed out after {TPU_TIMEOUT_S}s"
+                except (RuntimeError, OSError) as exc:
+                    tpu_error = f"measurement failed: {exc}"
+                print(f"bench: {tpu_error}", file=sys.stderr)
+    measured = _run_child(["--light", "--cpu"], {}, CPU_TIMEOUT_S)
+    return measured, tpu_error
 
 
 def torch_baseline_steps_per_sec() -> float:
+    cache_key = [B, T, F, E, H, TORCH_STEPS]
     if os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE, encoding="utf-8") as f:
             cached = json.load(f)
-        if cached.get("config") == [B, T, F, E, H]:
+        if cached.get("config") == cache_key:
             return float(cached["torch_cpu_steps_per_sec"])
 
     from benchmarks.baseline_torch import measure_steps_per_sec
 
     sps = measure_steps_per_sec(batch=B, window=T, num_features=F,
-                                num_metrics=E, hidden=H, steps=3, warmup=1)
+                                num_metrics=E, hidden=H,
+                                steps=TORCH_STEPS, warmup=TORCH_WARMUP)
     try:
         with open(BASELINE_CACHE, "w", encoding="utf-8") as f:
-            json.dump({"config": [B, T, F, E, H],
+            json.dump({"config": cache_key,
                        "torch_cpu_steps_per_sec": sps,
-                       "note": "reference-equivalent torch model, this host's CPU"},
+                       "note": "reference-equivalent torch model, this host's"
+                               f" CPU, {TORCH_STEPS} measured steps"},
                       f, indent=2)
     except OSError:
         pass
     return sps
 
 
+def _maybe_pallas_proof(platform: str) -> dict | None:
+    """On a real accelerator, record pallas-vs-scan numerics + speedup
+    (VERDICT round 1: the kernel had only ever run in interpret mode)."""
+    if platform == "cpu":
+        return None
+    out_path = os.path.join(REPO, "benchmarks", "pallas_tpu_result.json")
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks", "pallas_tpu_check.py"),
+             "--out", out_path],
+            capture_output=True, text=True, timeout=600, cwd=REPO, check=True,
+        )
+        with open(out_path, encoding="utf-8") as f:
+            return json.load(f)
+    except Exception as exc:  # best-effort: never sink the headline number
+        print(f"bench: pallas proof failed: {exc}", file=sys.stderr)
+        # The check script writes its findings (incl. a numerics failure)
+        # before exiting nonzero — keep that evidence if it exists.
+        try:
+            with open(out_path, encoding="utf-8") as f:
+                result = json.load(f)
+            result["error"] = str(exc)[:300]
+            return result
+        except OSError:
+            return {"error": str(exc)[:300]}
+
+
 def main() -> None:
-    jax_sps, platform = measure_jax_steps_per_sec()
-    torch_sps = torch_baseline_steps_per_sec()
-    print(json.dumps({
+    measured, tpu_error = _measure_with_fallback()
+    jax_sps = float(measured["steps_per_sec"])
+    platform = measured["platform"]
+    try:
+        torch_sps = torch_baseline_steps_per_sec()
+    except Exception as exc:
+        print(f"bench: torch baseline failed: {exc}", file=sys.stderr)
+        torch_sps = 0.0
+
+    result = {
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
-        "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, bf16)",
+        "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
+                f"{measured.get('dtype', 'bfloat16')})",
         "vs_baseline": round(jax_sps / torch_sps, 3) if torch_sps > 0 else None,
-    }))
+        "anchor": f"torch-CPU reference-equivalent model, {TORCH_STEPS} steps "
+                  f"({torch_sps:.4f} steps/s) — reference publishes no "
+                  "throughput; no GPU on this host",
+    }
+    if tpu_error is not None:
+        result["tpu_error"] = tpu_error[:400]
+    pallas = _maybe_pallas_proof(platform)
+    if pallas is not None:
+        result["pallas_tpu"] = pallas
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        import jax
+
+        print(json.dumps({"platform": jax.devices()[0].platform,
+                          "n_devices": len(jax.devices())}))
+    elif "--measure" in sys.argv:
+        measure_main(light="--light" in sys.argv, cpu="--cpu" in sys.argv)
+    else:
+        main()

@@ -33,6 +33,12 @@ GATEWAYS = ("nginx-thrift", "media-frontend")
 CONSUMER = "write-home-timeline-service"
 COLLECTOR = "trace-collector"
 
+
+def _is_durable_store(component: str) -> bool:
+    """kv (redis-role) and doc (mongodb-role) stores persist; caches and the
+    queue are RAM-only by fidelity to their reference counterparts."""
+    return component.endswith("-redis") or component.endswith("-mongodb")
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -66,13 +72,19 @@ class SnsCluster:
     """
 
     def __init__(self, out_path: str, interval_ms: int = 5000,
-                 grace_ms: int = 1000, verbose: bool = False):
+                 grace_ms: int = 1000, verbose: bool = False,
+                 data_dir: str | None = None):
         self.out_path = os.path.abspath(out_path)
         self.interval_ms = interval_ms
         self.grace_ms = grace_ms
         self.verbose = verbose
+        # When set, kv/doc stores run durably (WAL + snapshots) under this
+        # directory — the process-cluster stand-in for the reference's
+        # per-store PVC mounts (user-timeline-mongodb.yaml:50-56).
+        self.data_dir = os.path.abspath(data_dir) if data_dir else None
         self.components: dict[str, tuple[str, int]] = {}
         self._procs: dict[str, subprocess.Popen] = {}
+        self._extras: dict[str, list[str]] = {}   # per-component spawn args
         self._config_path: str | None = None
 
     # -- addresses ------------------------------------------------------
@@ -125,12 +137,39 @@ class SnsCluster:
         return self
 
     def _spawn(self, component: str, extra: list[str] | None = None) -> None:
+        if extra is not None:
+            self._extras[component] = list(extra)
         cmd = [snsd_path(), f"--service={component}", f"--config={self._config_path}"]
-        cmd += extra or []
+        cmd += self._extras.get(component, [])
+        if self.data_dir and _is_durable_store(component):
+            os.makedirs(self.data_dir, exist_ok=True)
+            cmd.append(f"--data-dir={self.data_dir}")
         if self.verbose:
             cmd.append("--verbose")
         out = None if self.verbose else subprocess.DEVNULL
         self._procs[component] = subprocess.Popen(cmd, stdout=out, stderr=out)
+
+    def restart(self, component: str, timeout: float = 10.0,
+                graceful: bool = False) -> None:
+        """Kill one component's process and respawn it on the same port.
+
+        ``graceful=False`` (SIGKILL) models a crash: a durable store must
+        come back with its pre-crash state from WAL replay.
+        """
+        proc = self._procs.get(component)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM if graceful else signal.SIGKILL)
+            proc.wait()
+        self._spawn(component)
+        host, port = self.components[component]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection((host, port), timeout=0.25):
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise TimeoutError(f"{component} did not come back after restart")
 
     def _wait_ready(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout

@@ -125,13 +125,22 @@ def _gru_pallas(
         proj = jnp.einsum("ebtf,efg->etbg", x, params.w_ih)
     proj = proj + params.b_ih[:, None, None, :]
 
+    # The kernel computes in f32; feeding it sub-32-bit operands would also
+    # tighten the sublane tiling granularity (bf16 needs 16 rows, not 8) on
+    # the batch axis of every [.., B, ..] block.  Upcast at the boundary so
+    # pad_batch's f32 granularity is always valid regardless of the model's
+    # compute dtype.
+    proj = proj.astype(jnp.float32)
+    h0 = h0.astype(jnp.float32)
+
     e, t, b, _ = proj.shape
     b_pad = pallas_gru.pad_batch(b)
     if b_pad != b:
         proj = jnp.pad(proj, ((0, 0), (0, 0), (0, b_pad - b), (0, 0)))
         h0 = jnp.pad(h0, ((0, 0), (0, b_pad - b), (0, 0)))
     e_pad = -e % pallas_gru.E_BLK
-    w_hh, b_hh = params.w_hh, params.b_hh
+    w_hh = params.w_hh.astype(jnp.float32)
+    b_hh = params.b_hh.astype(jnp.float32)
     if e_pad:
         proj = jnp.pad(proj, ((0, e_pad), (0, 0), (0, 0), (0, 0)))
         w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
@@ -183,6 +192,17 @@ def gru(
         if pallas_gru.supported(x.shape[-2], params.hidden_size):
             return _gru_pallas(params, x, h0, reverse,
                                interpret=resolved == "pallas_interpret")
+        if backend != "auto":
+            # An explicit pallas request that silently ran the scan path
+            # would hide a perf bug; 'auto' falls through quietly by design.
+            import warnings
+
+            warnings.warn(
+                f"GRU backend {backend!r} requested but unsupported for "
+                f"T={x.shape[-2]}, H={params.hidden_size} (needs H % 128 == 0);"
+                " falling back to lax.scan",
+                stacklevel=2,
+            )
     return _gru_scan(params, x, h0, reverse=reverse, unroll=unroll)
 
 

@@ -420,6 +420,20 @@ void RpcServer::HandleConnection(int fd, uint64_t conn_id) {
 // ---------------------------------------------------------------------------
 // RpcClient / ClientPool
 
+bool IsIdempotentRpc(const std::string& method) {
+  // Store-plane reads plus set-semantics writes. Deliberately excluded:
+  // hincrby, insert, update (push-front), pull, publish, consume, and every
+  // app-service saga method (they fan out into non-idempotent store ops).
+  static const char* kIdempotent[] = {
+      "find", "findone", "hgetall", "zrange", "zrevrange", "zcard", "bytes",
+      "get",  "mget",    "depth",   "hset",   "zadd",      "zrem",  "del",
+      "expire", "createindex", "set",
+  };
+  for (const char* m : kIdempotent)
+    if (method == m) return true;
+  return false;
+}
+
 bool RpcClient::Connect() {
   conn_ = FramedSocket::Connect(host_, port_);
   return conn_ != nullptr;
@@ -428,17 +442,21 @@ bool RpcClient::Connect() {
 Json RpcClient::Call(const std::string& method, const TraceContext& ctx,
                      const Json& args) {
   if (!connected() && !Connect())
-    throw std::runtime_error("connect to " + host_ + ":" + std::to_string(port_) +
-                             " failed");
+    throw TransportError("connect to " + host_ + ":" + std::to_string(port_) +
+                             " failed",
+                         /*sent=*/false);
+  // A failed/partial frame write cannot be parsed by the peer, so it will
+  // not have executed: still safely retryable.
   if (!conn_->WriteFrame(EncodeRequest(method, ctx, args)))
-    throw std::runtime_error("rpc write failed");
+    throw TransportError("rpc write failed", /*sent=*/false);
   std::string frame;
-  if (!conn_->ReadFrame(&frame)) throw std::runtime_error("rpc read failed");
+  if (!conn_->ReadFrame(&frame))
+    throw TransportError("rpc read failed", /*sent=*/true);
   bool ok;
   std::string error;
   Json result;
   if (!DecodeResponse(frame, &ok, &error, &result))
-    throw std::runtime_error("rpc bad response frame");
+    throw TransportError("rpc bad response frame", /*sent=*/true);
   if (!ok) throw std::runtime_error(method + ": " + error);
   return result;
 }
@@ -476,6 +494,26 @@ Json ClientPool::Call(const std::string& method, const TraceContext& ctx,
   try {
     Json result = client->Call(method, ctx, args);
     Push(std::move(client));
+    return result;
+  } catch (const TransportError& te) {
+    // Peer likely restarted: every idle connection to it is stale. Drop
+    // them all; retry once on a fresh socket when it is safe — the request
+    // provably never reached the peer, or re-execution is idempotent. A
+    // possibly-executed non-idempotent call must NOT be retried (it would
+    // double-apply), and a second transport failure propagates.
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      idle_.clear();
+    }
+    Push(nullptr);  // evict broken client (reference: ClientPool.h:138-146)
+    if (te.request_sent && !IsIdempotentRpc(method)) throw;
+    auto fresh = std::make_unique<RpcClient>(host_, port_);
+    Json result = fresh->Call(method, ctx, args);
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      ++outstanding_;
+    }
+    Push(std::move(fresh));
     return result;
   } catch (...) {
     Push(nullptr);  // evict broken client (reference: ClientPool.h:138-146)

@@ -193,10 +193,28 @@ class RpcServer {
 // RPC client + pool (reference: ClientPool.h — deque+mutex+condvar, grow to
 // max then block with timeout, evict broken clients)
 
+// Connection-level failure (connect / write / read / framing), unlike an
+// application error the peer deliberately returned. A restarted peer
+// (elastic recovery, SURVEY.md §5.3) surfaces as exactly this.
+// ``request_sent`` gates retry safety: if the frame never reached the peer
+// the call is retryable unconditionally; if it may have been executed
+// (failure while awaiting the response), only idempotent methods may retry
+// — a blind retry would double-apply e.g. hincrby or insert.
+struct TransportError : std::runtime_error {
+  explicit TransportError(const std::string& what, bool sent = false)
+      : std::runtime_error(what), request_sent(sent) {}
+  bool request_sent;
+};
+
+// Methods safe to re-execute after an ambiguous failure (reads, and
+// set-semantics writes where re-applying converges to the same state).
+bool IsIdempotentRpc(const std::string& method);
+
 class RpcClient {
  public:
   RpcClient(std::string host, int port) : host_(std::move(host)), port_(port) {}
-  // Throws std::runtime_error on transport or application error.
+  // Throws TransportError on connection failure, std::runtime_error on an
+  // application-level error response.
   Json Call(const std::string& method, const TraceContext& ctx, const Json& args);
   bool Connect();
   bool connected() const { return conn_ && conn_->ok(); }

@@ -27,6 +27,7 @@
 #include "gateway.h"
 #include "services.h"
 #include "store.h"
+#include "wal.h"
 
 namespace sns {
 namespace {
@@ -90,16 +91,41 @@ int RunRole(const std::string& component, ClusterConfig& cfg, int argc,
     server.Stop();
   };
 
+  // --data-dir=<path>: durable kv/doc stores (WAL + snapshots under the
+  // deployment's PVC mount — deploy/generate.py). Cache stays RAM-only
+  // (memcached semantics) and the queue is drained-on-restart, matching the
+  // reference's non-durable declarations.
+  std::string data_dir = ArgValue(argc, argv, "data-dir");
+  int snapshot_every =
+      std::stoi(ArgValue(argc, argv, "snapshot-every", "512"));
   std::string kind = StoreKindFor(component);
   if (kind == "kv") {
     KvEngine engine;
+    std::unique_ptr<Wal> wal;
+    if (!data_dir.empty()) {
+      wal = std::make_unique<Wal>(data_dir, component, snapshot_every);
+      engine.LoadState(wal->LoadSnapshot());
+      wal->Replay([&](const std::string& m, const Json& a) {
+        ApplyKvMutation(&engine, m, a);
+      });
+      wal->SetSnapshotFn([&engine] { return engine.DumpState(); });
+    }
     RpcServer server(component, self.port);
-    RegisterKvService(&server, &engine);
+    RegisterKvService(&server, &engine, wal.get());
     serve_until_signal(server);
   } else if (kind == "doc") {
     DocEngine engine;
+    std::unique_ptr<Wal> wal;
+    if (!data_dir.empty()) {
+      wal = std::make_unique<Wal>(data_dir, component, snapshot_every);
+      engine.LoadState(wal->LoadSnapshot());
+      wal->Replay([&](const std::string& m, const Json& a) {
+        ApplyDocMutation(&engine, m, a);
+      });
+      wal->SetSnapshotFn([&engine] { return engine.DumpState(); });
+    }
     RpcServer server(component, self.port);
-    RegisterDocService(&server, &engine);
+    RegisterDocService(&server, &engine, wal.get());
     serve_until_signal(server);
   } else if (kind == "cache") {
     CacheEngine engine;

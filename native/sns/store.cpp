@@ -4,6 +4,8 @@
 #include <chrono>
 #include <cstring>
 
+#include "wal.h"
+
 namespace sns {
 
 // ---------------------------------------------------------------------------
@@ -90,8 +92,12 @@ int64_t KvEngine::ZCard(const std::string& key) {
 }
 
 void KvEngine::Expire(const std::string& key, int64_t ttl_ms) {
+  ExpireAt(key, NowNs() + static_cast<uint64_t>(ttl_ms) * 1000000ull);
+}
+
+void KvEngine::ExpireAt(const std::string& key, uint64_t deadline_ns) {
   std::lock_guard<std::mutex> lock(mu_);
-  expiry_ns_[key] = NowNs() + static_cast<uint64_t>(ttl_ms) * 1000000ull;
+  expiry_ns_[key] = deadline_ns;
 }
 
 void KvEngine::Del(const std::string& key) {
@@ -99,6 +105,44 @@ void KvEngine::Del(const std::string& key) {
   hashes_.erase(key);
   zsets_.erase(key);
   expiry_ns_.erase(key);
+}
+
+Json KvEngine::DumpState() {
+  std::lock_guard<std::mutex> lock(mu_);
+  JsonObject hashes, zsets, expiry;
+  for (const auto& [k, h] : hashes_) {
+    JsonObject fields;
+    for (const auto& [f, v] : h) fields[f] = Json(v);
+    hashes[k] = Json(std::move(fields));
+  }
+  for (const auto& [k, z] : zsets_) {
+    JsonObject members;
+    for (const auto& [m, s] : z) members[m] = Json(s);
+    zsets[k] = Json(std::move(members));
+  }
+  for (const auto& [k, ns] : expiry_ns_) expiry[k] = Json(ns);
+  Json out;
+  out.set("hashes", Json(std::move(hashes)))
+      .set("zsets", Json(std::move(zsets)))
+      .set("expiry", Json(std::move(expiry)));
+  return out;
+}
+
+void KvEngine::LoadState(const Json& state) {
+  std::lock_guard<std::mutex> lock(mu_);
+  hashes_.clear();
+  zsets_.clear();
+  expiry_ns_.clear();
+  if (!state.is_object()) return;
+  if (state.has("hashes"))
+    for (const auto& [k, h] : state["hashes"].as_object())
+      for (const auto& [f, v] : h.as_object()) hashes_[k][f] = v.as_string();
+  if (state.has("zsets"))
+    for (const auto& [k, z] : state["zsets"].as_object())
+      for (const auto& [m, s] : z.as_object()) zsets_[k][m] = s.as_double();
+  if (state.has("expiry"))
+    for (const auto& [k, ns] : state["expiry"].as_object())
+      expiry_ns_[k] = ns.as_uint();
 }
 
 size_t KvEngine::ApproxBytes() {
@@ -221,6 +265,44 @@ void DocEngine::Pull(const std::string& collection, const std::string& field,
   }
 }
 
+Json DocEngine::DumpState() {
+  std::lock_guard<std::mutex> lock(mu_);
+  JsonObject colls;
+  for (const auto& [name, c] : colls_) {
+    JsonArray docs(c.docs.begin(), c.docs.end());
+    JsonArray index_fields;
+    for (const auto& [field, idx] : c.indexes) {
+      (void)idx;
+      index_fields.push_back(Json(field));
+    }
+    Json coll;
+    coll.set("docs", Json(std::move(docs)))
+        .set("indexes", Json(std::move(index_fields)));
+    colls[name] = std::move(coll);
+  }
+  Json out;
+  out.set("colls", Json(std::move(colls)));
+  return out;
+}
+
+void DocEngine::LoadState(const Json& state) {
+  std::lock_guard<std::mutex> lock(mu_);
+  colls_.clear();
+  if (!state.is_object() || !state.has("colls")) return;
+  for (const auto& [name, coll] : state["colls"].as_object()) {
+    auto& c = Coll(name);
+    if (coll.has("docs")) c.docs = coll["docs"].as_array();
+    if (coll.has("indexes"))
+      for (const auto& field : coll["indexes"].as_array()) {
+        auto& idx = c.indexes[field.as_string()];
+        idx.clear();
+        for (size_t i = 0; i < c.docs.size(); ++i)
+          if (c.docs[i].has(field.as_string()))
+            idx[IndexKey(c.docs[i][field.as_string()])].push_back(i);
+      }
+  }
+}
+
 size_t DocEngine::ApproxBytes() {
   std::lock_guard<std::mutex> lock(mu_);
   size_t n = 0;
@@ -299,24 +381,111 @@ size_t QueueEngine::Depth(const std::string& queue) {
 }
 
 // ---------------------------------------------------------------------------
-// RPC wrappers
+// Mutation dispatch — the single code path for live RPC serving and WAL
+// replay (wal.h), so a recovered engine is bit-identical to one that never
+// restarted.
 
-void RegisterKvService(RpcServer* server, KvEngine* e) {
-  server->Register("hset", [e](const TraceContext&, const Json& a) {
+Json ApplyKvMutation(KvEngine* e, const std::string& m, const Json& a) {
+  if (m == "hset") {
     e->HSet(a["key"].as_string(), a["field"].as_string(), a["value"].dump());
     return Json(true);
-  });
-  server->Register("hincrby", [e](const TraceContext&, const Json& a) {
+  }
+  if (m == "hincrby")
     return Json(e->HIncrBy(a["key"].as_string(), a["field"].as_string(),
                            a["by"].as_int(1)));
-  });
-  server->Register("hgetall", [e](const TraceContext&, const Json& a) {
-    return e->HGetAll(a["key"].as_string());
-  });
-  server->Register("zadd", [e](const TraceContext&, const Json& a) {
+  if (m == "zadd") {
     e->ZAdd(a["key"].as_string(), a["score"].as_double(),
             a["member"].as_string());
     return Json(true);
+  }
+  if (m == "zrem") {
+    e->ZRem(a["key"].as_string(), a["member"].as_string());
+    return Json(true);
+  }
+  if (m == "expire") {
+    // Normalized records carry an absolute deadline; raw RPC args carry a
+    // relative TTL. Replaying a relative TTL would re-arm it from replay
+    // time, resurrecting keys that expired before the crash.
+    if (a.has("deadline_ns"))
+      e->ExpireAt(a["key"].as_string(), a["deadline_ns"].as_uint());
+    else
+      e->Expire(a["key"].as_string(), a["ttl_ms"].as_int(10000));
+    return Json(true);
+  }
+  if (m == "del") {
+    e->Del(a["key"].as_string());
+    return Json(true);
+  }
+  throw std::runtime_error("unknown kv mutation: " + m);
+}
+
+Json ApplyDocMutation(DocEngine* e, const std::string& m, const Json& a) {
+  if (m == "insert") {
+    e->Insert(a["coll"].as_string(), a["doc"]);
+    return Json(true);
+  }
+  if (m == "update") {
+    e->PushFront(a["coll"].as_string(), a["field"].as_string(), a["value"],
+                 a["array_field"].as_string(), a["push"]);
+    return Json(true);
+  }
+  if (m == "pull") {
+    e->Pull(a["coll"].as_string(), a["field"].as_string(), a["value"],
+            a["array_field"].as_string(), a["pull"]);
+    return Json(true);
+  }
+  if (m == "createindex") {
+    e->CreateIndex(a["coll"].as_string(), a["field"].as_string());
+    return Json(true);
+  }
+  throw std::runtime_error("unknown doc mutation: " + m);
+}
+
+namespace {
+
+// Rewrites time-relative mutation args into time-absolute ones so the WAL
+// record replays identically at any later wall-clock (expire: ttl_ms ->
+// deadline_ns).
+Json NormalizeKvMutation(const std::string& m, const Json& a) {
+  if (m == "expire" && !a.has("deadline_ns")) {
+    Json out = a;
+    out.set("deadline_ns",
+            Json(static_cast<uint64_t>(
+                NowNs() +
+                static_cast<uint64_t>(a["ttl_ms"].as_int(10000)) * 1000000ull)));
+    return out;
+  }
+  return a;
+}
+
+// Registers one mutating method: applied via apply_fn, and — when a WAL is
+// attached — applied+logged atomically so log order equals engine order.
+template <typename Engine>
+void RegisterMutation(RpcServer* server, Engine* e, Wal* wal,
+                      const std::string& method,
+                      Json (*apply_fn)(Engine*, const std::string&, const Json&),
+                      Json (*normalize)(const std::string&, const Json&) = nullptr) {
+  server->Register(
+      method, [e, wal, method, apply_fn, normalize](const TraceContext&,
+                                                    const Json& a) {
+        Json na = normalize ? normalize(method, a) : a;
+        if (wal)
+          return wal->LoggedApply(method, na,
+                                  [&] { return apply_fn(e, method, na); });
+        return apply_fn(e, method, na);
+      });
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// RPC wrappers
+
+void RegisterKvService(RpcServer* server, KvEngine* e, Wal* wal) {
+  for (const char* m : {"hset", "hincrby", "zadd", "zrem", "expire", "del"})
+    RegisterMutation(server, e, wal, m, &ApplyKvMutation, &NormalizeKvMutation);
+  server->Register("hgetall", [e](const TraceContext&, const Json& a) {
+    return e->HGetAll(a["key"].as_string());
   });
   auto zrange = [e](const Json& a, bool reverse) {
     JsonArray out;
@@ -331,51 +500,23 @@ void RegisterKvService(RpcServer* server, KvEngine* e) {
   server->Register("zrevrange", [zrange](const TraceContext&, const Json& a) {
     return zrange(a, true);
   });
-  server->Register("zrem", [e](const TraceContext&, const Json& a) {
-    e->ZRem(a["key"].as_string(), a["member"].as_string());
-    return Json(true);
-  });
   server->Register("zcard", [e](const TraceContext&, const Json& a) {
     return Json(e->ZCard(a["key"].as_string()));
-  });
-  server->Register("expire", [e](const TraceContext&, const Json& a) {
-    e->Expire(a["key"].as_string(), a["ttl_ms"].as_int(10000));
-    return Json(true);
-  });
-  server->Register("del", [e](const TraceContext&, const Json& a) {
-    e->Del(a["key"].as_string());
-    return Json(true);
   });
   server->Register("bytes", [e](const TraceContext&, const Json&) {
     return Json(static_cast<uint64_t>(e->ApproxBytes()));
   });
 }
 
-void RegisterDocService(RpcServer* server, DocEngine* e) {
-  server->Register("insert", [e](const TraceContext&, const Json& a) {
-    e->Insert(a["coll"].as_string(), a["doc"]);
-    return Json(true);
-  });
+void RegisterDocService(RpcServer* server, DocEngine* e, Wal* wal) {
+  for (const char* m : {"insert", "update", "pull", "createindex"})
+    RegisterMutation(server, e, wal, m, &ApplyDocMutation);
   server->Register("find", [e](const TraceContext&, const Json& a) {
     return e->Find(a["coll"].as_string(), a["field"].as_string(), a["value"],
                    a["limit"].as_int(-1));
   });
   server->Register("findone", [e](const TraceContext&, const Json& a) {
     return e->FindOne(a["coll"].as_string(), a["field"].as_string(), a["value"]);
-  });
-  server->Register("update", [e](const TraceContext&, const Json& a) {
-    e->PushFront(a["coll"].as_string(), a["field"].as_string(), a["value"],
-                 a["array_field"].as_string(), a["push"]);
-    return Json(true);
-  });
-  server->Register("pull", [e](const TraceContext&, const Json& a) {
-    e->Pull(a["coll"].as_string(), a["field"].as_string(), a["value"],
-            a["array_field"].as_string(), a["pull"]);
-    return Json(true);
-  });
-  server->Register("createindex", [e](const TraceContext&, const Json& a) {
-    e->CreateIndex(a["coll"].as_string(), a["field"].as_string());
-    return Json(true);
   });
   server->Register("bytes", [e](const TraceContext&, const Json&) {
     return Json(static_cast<uint64_t>(e->ApproxBytes()));

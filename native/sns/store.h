@@ -40,8 +40,13 @@ class KvEngine {
                                   int64_t stop, bool reverse);
   int64_t ZCard(const std::string& key);
   void Expire(const std::string& key, int64_t ttl_ms);
+  void ExpireAt(const std::string& key, uint64_t deadline_ns);
   void Del(const std::string& key);
   size_t ApproxBytes();
+  // Durable-state round trip (wal.h snapshots). Expiry deadlines are
+  // CLOCK_REALTIME-absolute, so they survive a restart as-is.
+  Json DumpState();
+  void LoadState(const Json& state);
 
  private:
   void MaybeExpire(const std::string& key);
@@ -73,6 +78,10 @@ class DocEngine {
   void Pull(const std::string& collection, const std::string& field,
             const Json& match, const std::string& array_field, const Json& value);
   size_t ApproxBytes();
+  // Durable-state round trip (wal.h snapshots): docs plus index fields
+  // (indexes are rebuilt on load, not serialized).
+  Json DumpState();
+  void LoadState(const Json& state);
 
  private:
   struct Collection {
@@ -128,9 +137,20 @@ class QueueEngine {
 // per-call server spans ("/hset", "/find", "/mget", ...) line up with the
 // trace vocabulary the featurizer and the workload simulator share
 // (deeprest_tpu/workload/topology.py).
+//
+// Mutating methods route through Apply{Kv,Doc}Mutation — the single
+// dispatch shared by live RPC serving and WAL replay (wal.h), so recovery
+// can never apply an op differently than serving did. When `wal` is given,
+// kv/doc mutations are applied+logged atomically via Wal::LoggedApply.
 
-void RegisterKvService(RpcServer* server, KvEngine* engine);
-void RegisterDocService(RpcServer* server, DocEngine* engine);
+class Wal;
+
+// Applies one mutating op; returns its RPC result. Unknown methods throw.
+Json ApplyKvMutation(KvEngine* engine, const std::string& method, const Json& args);
+Json ApplyDocMutation(DocEngine* engine, const std::string& method, const Json& args);
+
+void RegisterKvService(RpcServer* server, KvEngine* engine, Wal* wal = nullptr);
+void RegisterDocService(RpcServer* server, DocEngine* engine, Wal* wal = nullptr);
 void RegisterCacheService(RpcServer* server, CacheEngine* engine);
 void RegisterQueueService(RpcServer* server, QueueEngine* engine);
 

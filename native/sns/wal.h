@@ -1,0 +1,80 @@
+// Durability for the store engines: write-ahead log + periodic snapshot.
+//
+// The reference's stateful tier persists through real database engines on
+// OpenEBS per-PVC volumes — the whole L0 substrate exists so that per-store
+// write-IOps / write-throughput / disk-usage are live signals for the model
+// (reference: minikube-openebs/README.md:2, monitor-openebs-pg.yaml:60-91,
+// user-timeline-mongodb.yaml:50-56).  The native equivalent: every mutating
+// store op is appended to a per-component log under --data-dir and
+// fdatasync'd, so the store process produces genuine disk writes that the
+// collector's /proc/<pid>/io sampling sees; every SNAPSHOT_EVERY appends the
+// engine state is checkpointed (tmp + fsync + rename) and the log truncated;
+// on restart the snapshot is loaded and the log tail replayed.
+//
+// Record format: one JSON line per mutation, {"m": method, "a": args} — the
+// same (method, args) pair the RPC layer dispatches, so replay reuses the
+// exact mutation-application code path (store.h Apply*Mutation) and cannot
+// drift from live serving.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+
+#include "json.h"
+
+namespace sns {
+
+class Wal {
+ public:
+  // Files live at <dir>/<component>.wal and <dir>/<component>.snap.
+  // The directory must already exist (the deployment's PVC mount point).
+  Wal(const std::string& dir, const std::string& component,
+      int snapshot_every = 512);
+  ~Wal();
+
+  Wal(const Wal&) = delete;
+  Wal& operator=(const Wal&) = delete;
+
+  // -- recovery (call before serving) ----------------------------------
+  // Returns the last snapshot's engine state, or a null Json if none.
+  // Remembers the snapshot's sequence number so Replay can skip records
+  // the snapshot already folded in (a crash between snapshot rename and
+  // log truncation would otherwise double-apply non-idempotent ops).
+  Json LoadSnapshot();
+  // Replays every log record with seq > snapshot seq through `apply`.
+  // Corrupt/partial tail lines (a crash mid-append) are dropped.
+  void Replay(const std::function<void(const std::string&, const Json&)>& apply);
+
+  // -- serving ---------------------------------------------------------
+  // The engine-state dump used by periodic snapshots.
+  void SetSnapshotFn(std::function<Json()> fn);
+  // Serialize one mutation: apply it through `apply` and append the record
+  // durably (fdatasync). One mutex orders application and logging together,
+  // so the log's order is exactly the order mutations hit the engine.
+  Json LoggedApply(const std::string& method, const Json& args,
+                   const std::function<Json()>& apply);
+  // Force a snapshot now (also truncates the log). Used by tests.
+  void Snapshot();
+
+  const std::string& wal_path() const { return wal_path_; }
+  const std::string& snap_path() const { return snap_path_; }
+
+ private:
+  void OpenLog(bool truncate);
+  void AppendLocked(const std::string& method, const Json& args);
+  void SnapshotLocked();
+
+  std::string wal_path_;
+  std::string snap_path_;
+  int snapshot_every_;
+  int fd_ = -1;
+  int appends_since_snapshot_ = 0;
+  uint64_t seq_ = 0;       // last sequence number written (or recovered)
+  uint64_t snap_seq_ = 0;  // sequence folded into the loaded snapshot
+  std::function<Json()> snapshot_fn_;
+  std::mutex mu_;
+};
+
+}  // namespace sns

@@ -64,10 +64,15 @@ def live_corpus(tmp_path_factory):
     normal scenario, and return (buckets, stats)."""
     if not snsd_available():
         pytest.skip("snsd not built (make -C native/sns)")
-    out = str(tmp_path_factory.mktemp("live") / "raw.jsonl")
+    live_dir = tmp_path_factory.mktemp("live")
+    out = str(live_dir / "raw.jsonl")
     graph = synthetic_social_graph(24, seed=1)
     scenario = normal_scenario(0)
-    with SnsCluster(out_path=out, interval_ms=500, grace_ms=300) as cluster:
+    # data_dir makes kv/doc stores durable (WAL + fsync), so the corpus
+    # carries real write-iops / write-tp / usage telemetry — the signals the
+    # reference's OpenEBS PVC tier exists to produce.
+    with SnsCluster(out_path=out, interval_ms=500, grace_ms=300,
+                    data_dir=str(live_dir / "pvc")) as cluster:
         stats = warmup(*cluster.gateway_addr, graph)
         runner = LoadRunner(
             cluster.gateway_addr, graph, scenario,
@@ -111,6 +116,37 @@ def test_live_corpus_featurizes(live_corpus):
     assert "cpu" in resources
     cpu_keys = [k for k in data.resources if k.endswith("_cpu")]
     assert any(np.asarray(data.resources[k]).sum() > 0 for k in cpu_keys)
+
+
+@needs_snsd
+def test_live_corpus_write_telemetry_nonzero(live_corpus):
+    """Durable stores must produce *real* disk-write telemetry on the live
+    path: /proc-sampled write-iops and write-tp above zero, and logical
+    usage that grows as documents land (round-1 verdict: RAM-only stores
+    made two of the five modeled resources degenerate)."""
+    buckets, _, _ = live_corpus
+
+    def series(component, resource):
+        return [m.value for b in buckets for m in b.metrics
+                if m.component == component and m.resource == resource]
+
+    mongo_stores = {m.component for b in buckets for m in b.metrics
+                    if m.component.endswith("-mongodb")}
+    assert mongo_stores
+    assert any(max(series(c, "write-iops"), default=0) > 0 for c in mongo_stores), \
+        "no mongodb-role store recorded any write syscalls"
+    assert any(max(series(c, "write-tp"), default=0) > 0 for c in mongo_stores), \
+        "no mongodb-role store recorded any write throughput"
+    # usage (logical dataset size) must grow on the post path — posts only
+    # accumulate. Trailing buckets may read 0 (store already stopped when
+    # the collector's final sample RPC fails), so compare nonzero samples.
+    usage = [u for u in series("post-storage-mongodb", "usage") if u > 0]
+    assert usage, "post-storage-mongodb never reported usage"
+    assert usage[-1] >= usage[0] and usage[-1] > 0
+    # redis-role stores write their WAL too
+    redis_stores = {m.component for b in buckets for m in b.metrics
+                    if m.component.endswith("-redis")}
+    assert any(max(series(c, "write-tp"), default=0) > 0 for c in redis_stores)
 
 
 @needs_snsd
