@@ -175,17 +175,78 @@ def cmd_synthesize(args) -> int:
     from deeprest_tpu.config import FeaturizeConfig
     from deeprest_tpu.data.featurize import CallPathSpace
 
-    space = CallPathSpace(config=FeaturizeConfig(
-        capacity=args.capacity, round_to=args.round_to,
-        hash_features=args.hash_features))
+    if args.ckpt_dir:
+        # Use the checkpoint's training-time space so the synthesized
+        # columns are exact for that model by construction.
+        from deeprest_tpu.serve.predictor import Predictor
+
+        space = Predictor.from_checkpoint(args.ckpt_dir).space()
+        if space is None:
+            sys.exit("error: checkpoint has no feature space")
+    else:
+        space = CallPathSpace(config=FeaturizeConfig(
+            capacity=args.capacity, round_to=args.round_to,
+            hash_features=args.hash_features))
     synth = TraceSynthesizer(space).fit(buckets)
     mix = json.loads(args.mix)
     series = synth.synthesize_series([mix] * args.ticks, seed=args.seed)
     out = _ensure_npz(args.out)
-    np.savez_compressed(out, traffic=series.astype(np.float32))
+    # Embed the space so `predict --features` can verify column identity
+    # against the serving checkpoint (same contract as FeaturizedData.save);
+    # a bare traffic array would silently bypass that guard.
+    np.savez_compressed(
+        out, traffic=series.astype(np.float32),
+        space_json=np.frombuffer(
+            json.dumps(space.to_dict()).encode(), dtype=np.uint8),
+    )
     print(json.dumps({"out": out, "ticks": args.ticks,
                       "endpoints": synth.endpoints,
                       "capacity": int(space.capacity)}))
+    return 0
+
+
+def cmd_stream(args) -> int:
+    """Continuous retrain: tail a growing raw-data JSONL, fine-tune, and
+    re-checkpoint (BASELINE.json config 5; train/stream.py docstring has
+    the drift-handling design)."""
+    from deeprest_tpu.config import (
+        Config, FeaturizeConfig, ModelConfig, TrainConfig,
+    )
+    from deeprest_tpu.train.stream import (
+        BucketTailer, StreamConfig, StreamingTrainer,
+    )
+
+    cfg = Config(
+        model=ModelConfig(feature_dim=args.capacity,
+                          hidden_size=args.hidden_size,
+                          compute_dtype=args.compute_dtype),
+        train=TrainConfig(batch_size=args.batch_size, window_size=args.window,
+                          learning_rate=args.lr, seed=args.seed,
+                          eval_stride=1, eval_max_cycles=args.eval_holdout,
+                          log_every_steps=0),
+    )
+    st = StreamingTrainer(
+        cfg,
+        StreamConfig(refresh_buckets=args.refresh_buckets,
+                     finetune_epochs=args.finetune_epochs,
+                     history_max=args.history_max,
+                     eval_holdout=args.eval_holdout,
+                     poll_interval_s=args.poll_interval),
+        ckpt_dir=args.ckpt_dir,
+        feature_config=FeaturizeConfig(hash_features=True,
+                                       capacity=args.capacity,
+                                       hash_seed=args.hash_seed),
+    )
+    tailer = BucketTailer(args.raw)
+    for r in st.run(tailer,
+                    max_refreshes=args.max_refreshes or None,
+                    deadline_s=args.deadline or None):
+        print(json.dumps({
+            "refresh": r.refresh, "buckets": r.num_buckets,
+            "train_loss": round(r.train_loss, 6),
+            "eval_loss": round(r.eval_loss, 6),
+            "checkpoint": r.checkpoint_path,
+        }), flush=True)
     return 0
 
 
@@ -254,6 +315,16 @@ def cmd_anomaly(args) -> int:
         from deeprest_tpu.data.featurize import FeaturizedData
 
         data = FeaturizedData.load(args.features)
+        # Same vocabulary-identity guard as `predict --features`: equal
+        # width with a permuted vocabulary would silently produce bogus
+        # anomaly reports.
+        if (pred.space_dict is not None
+                and data.space.to_dict()["vocabulary"]
+                != pred.space_dict["vocabulary"]):
+            sys.exit("error: the features file was extracted with a "
+                     "different call-path vocabulary than the checkpoint "
+                     "was trained on; re-featurize the raw corpus with "
+                     "--raw (uses the checkpoint's space)")
     else:
         # featurize against the checkpoint's space for column exactness
         space = pred.space()
@@ -326,8 +397,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help='JSON {endpoint: count} per time step')
     p.add_argument("--ticks", type=int, default=60)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="use this checkpoint's feature space (column-exact "
+                        "for that model)")
     p.add_argument("--out", default="synthetic.npz")
     p.set_defaults(fn=cmd_synthesize)
+
+    p = sub.add_parser("stream",
+                       help="tail a growing raw corpus; fine-tune + "
+                            "re-checkpoint continuously")
+    p.add_argument("--raw", required=True,
+                   help="raw-data JSONL being appended to (collector --out)")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--capacity", type=int, default=512,
+                   help="hash-feature width (static model input dim)")
+    p.add_argument("--hash-seed", type=int, default=0x5EED)
+    p.add_argument("--window", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--hidden-size", type=int, default=128)
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--refresh-buckets", type=int, default=60,
+                   help="fine-tune after this many new buckets")
+    p.add_argument("--finetune-epochs", type=int, default=2)
+    p.add_argument("--history-max", type=int, default=4096)
+    p.add_argument("--eval-holdout", type=int, default=8,
+                   help="newest windows held out for eval each refresh")
+    p.add_argument("--poll-interval", type=float, default=0.5)
+    p.add_argument("--max-refreshes", type=int, default=0,
+                   help="stop after N refreshes (0 = run forever)")
+    p.add_argument("--deadline", type=float, default=0,
+                   help="stop after this many seconds (0 = no deadline)")
+    p.set_defaults(fn=cmd_stream)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")
     _add_input_args(p)

@@ -1,0 +1,319 @@
+"""Continuous retraining from a live, growing raw-data corpus.
+
+The reference is strictly offline: capture a corpus with minikube + locust,
+run featurize.py, run estimate.py (reference: resource-estimation/
+README.md:64-83).  This module closes the loop the reference leaves open
+(SURVEY.md §7.3 "streaming retrain ... no reference prior art; design
+explicitly"): tail the collector's JSONL as it grows, featurize buckets
+incrementally in hash mode (fixed width — no vocabulary pass, no recompile),
+and periodically fine-tune the model from its latest state, re-checkpointing
+after every refresh.
+
+Design decisions, explicit because there is no reference behavior to match:
+
+- **Hash featurization only.**  Dictionary mode needs a global vocabulary
+  pass and can change width; a stream has neither a "global" view nor any
+  tolerance for shape changes.  `FeaturizeConfig(hash_features=True,
+  capacity=F)` keeps the model input static forever.
+- **Expanding min-max normalization.**  Stats are the monotone union of
+  every refresh's observed range (never shrink).  Alternatives considered:
+  frozen initial stats (reference semantics — breaks under drift: values
+  outside the day-one range clip the model's usable dynamic range forever)
+  and sliding-window stats (adapt both ways, but re-anchor the output scale
+  every refresh, so two checkpoints' predictions are not comparable).  The
+  monotone union keeps every checkpoint's de-normalization consistent with
+  all earlier ones while still covering drifted ranges; windows are re-
+  normalized with the current stats at every refresh.
+- **Frozen metric set.**  The expert axis E is part of the compiled model.
+  The metric set freezes at the first refresh; components that stop
+  reporting fill with zeros, metrics that appear later are dropped (warned
+  once).  Restarting the stream from its checkpoint re-adopts the frozen
+  set.
+- **Recency-holdout eval.**  Each refresh trains on all windows but the
+  trailing ``eval_holdout`` and evaluates on those — the stream's notion of
+  "unseen" is "newest", which is what capacity planning on drifting traffic
+  actually faces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from collections import deque
+from typing import Callable, Iterator
+
+import numpy as np
+
+from deeprest_tpu.config import Config, FeaturizeConfig
+from deeprest_tpu.data.featurize import CallPathSpace
+from deeprest_tpu.data.schema import Bucket
+from deeprest_tpu.data.windows import MinMaxStats, sliding_windows
+from deeprest_tpu.train.data import DatasetBundle
+from deeprest_tpu.train.trainer import Trainer, TrainState
+
+
+class BucketTailer:
+    """Incrementally parse complete JSONL lines appended to a growing file.
+
+    Safe against torn tails: only lines terminated by a newline are parsed;
+    a partially-written last line stays buffered until its newline arrives.
+    The file may not exist yet at construction (collector still booting).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._carry = b""
+
+    def poll(self) -> list[Bucket]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size <= self._offset:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read(size - self._offset)
+        self._offset = size
+        data = self._carry + chunk
+        lines = data.split(b"\n")
+        self._carry = lines.pop()  # empty when data ends with a newline
+        buckets = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                buckets.append(Bucket.from_dict(json.loads(line)))
+            except (ValueError, KeyError, TypeError):
+                continue  # malformed line: skip, never wedge the stream
+        return buckets
+
+
+def expand_minmax(old: MinMaxStats | None, new: MinMaxStats) -> MinMaxStats:
+    """Monotone union of observed ranges (see module docstring)."""
+    if old is None:
+        return new
+    return MinMaxStats(
+        min=np.minimum(old.min, new.min),
+        max=np.maximum(old.max, new.max),
+    )
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    refresh_buckets: int = 60        # fine-tune after this many new buckets
+    finetune_epochs: int = 2
+    history_max: int = 4096          # retained buckets (memory bound)
+    eval_holdout: int = 8            # newest windows held out per refresh
+    poll_interval_s: float = 0.5
+
+
+@dataclasses.dataclass
+class RefreshResult:
+    refresh: int
+    num_buckets: int                 # retained corpus length at refresh time
+    train_loss: float
+    eval_loss: float
+    checkpoint_path: str | None
+
+
+class StreamingTrainer:
+    """Tail → featurize → fine-tune → checkpoint, repeatedly.
+
+    >>> st = StreamingTrainer(config, stream_cfg, ckpt_dir="/ckpts")
+    >>> for result in st.run(tailer):           # forever, or until stopped
+    ...     print(result.refresh, result.eval_loss)
+    """
+
+    def __init__(self, config: Config, stream: StreamConfig,
+                 ckpt_dir: str | None = None,
+                 feature_config: FeaturizeConfig | None = None):
+        fc = feature_config or FeaturizeConfig(
+            hash_features=True, capacity=config.model.feature_dim)
+        if not fc.hash_features or fc.capacity <= 0:
+            raise ValueError(
+                "streaming requires hash featurization with fixed capacity "
+                "(see module docstring)")
+        self.config = config
+        self.stream = stream
+        self.ckpt_dir = ckpt_dir
+        self.space = CallPathSpace(config=fc).freeze()
+        self.traffic: deque[np.ndarray] = deque(maxlen=stream.history_max)
+        self.metrics: deque[dict[str, float]] = deque(maxlen=stream.history_max)
+        self.metric_names: list[str] | None = None
+        self.trainer: Trainer | None = None
+        self.state: TrainState | None = None
+        self.x_stats: MinMaxStats | None = None
+        self.y_stats: MinMaxStats | None = None
+        self._warned_new_metrics: set[str] = set()
+        self._pending = 0
+        self._refresh_count = 0
+        self._maybe_resume()
+
+    # -- ingestion ------------------------------------------------------
+
+    def ingest(self, bucket: Bucket) -> None:
+        self.traffic.append(self.space.extract(bucket.traces))
+        self.metrics.append({m.key: m.value for m in bucket.metrics})
+        self._pending += 1
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.traffic)
+
+    def _freeze_metrics(self) -> list[str]:
+        if self.metric_names is None:
+            union: set[str] = set()
+            for row in self.metrics:
+                union |= set(row)
+            self.metric_names = sorted(union)
+        return self.metric_names
+
+    def _targets(self) -> np.ndarray:
+        names = self._freeze_metrics()
+        out = np.zeros((len(self.metrics), len(names)), np.float32)
+        name_pos = {n: i for i, n in enumerate(names)}
+        for t, row in enumerate(self.metrics):
+            for k, v in row.items():
+                i = name_pos.get(k)
+                if i is None:
+                    if k not in self._warned_new_metrics:
+                        self._warned_new_metrics.add(k)
+                        print(f"stream: metric {k!r} appeared after the "
+                              "metric set froze; dropping it")
+                    continue
+                out[t, i] = v
+        return out
+
+    # -- refresh --------------------------------------------------------
+
+    def ready(self) -> bool:
+        w = self.config.train.window_size
+        min_windows = self.stream.eval_holdout + 2
+        return (self._pending >= self.stream.refresh_buckets
+                and self.num_buckets > w + min_windows)
+
+    def refresh(self) -> RefreshResult:
+        """Fine-tune on the retained corpus; returns the refresh record."""
+        w = self.config.train.window_size
+        traffic = np.stack(list(self.traffic))
+        targets = self._targets()
+
+        x = sliding_windows(traffic, w)
+        y = sliding_windows(targets, w)
+        holdout = min(self.stream.eval_holdout, len(x) - 1)
+        split = len(x) - holdout
+
+        # Expanding stats: union with every past refresh (monotone).
+        self.x_stats = expand_minmax(
+            self.x_stats, MinMaxStats(min=np.float32(x[:split].min()),
+                                      max=np.float32(x[:split].max())))
+        self.y_stats = expand_minmax(
+            self.y_stats,
+            MinMaxStats(min=y[:split].min(axis=(0, 1)).astype(np.float32),
+                        max=y[:split].max(axis=(0, 1)).astype(np.float32)))
+
+        x_n = self.x_stats.apply(x).astype(np.float32)
+        y_n = self.y_stats.apply(y).astype(np.float32)
+        bundle = DatasetBundle(
+            x_train=x_n[:split], y_train=y_n[:split],
+            x_test=x_n[split:], y_test=y_n[split:],
+            x_stats=self.x_stats, y_stats=self.y_stats,
+            metric_names=self._freeze_metrics(), split=split,
+            window_size=w, space_dict=self.space.to_dict(),
+        )
+
+        if self.trainer is None:
+            model = dataclasses.replace(
+                self.config.model, feature_dim=self.space.capacity,
+                num_metrics=len(bundle.metric_names))
+            self.config = dataclasses.replace(self.config, model=model)
+            self.trainer = Trainer(self.config, self.space.capacity,
+                                   bundle.metric_names)
+        if self.state is None:
+            self.state = self.trainer.init_state(bundle.x_train)
+
+        data_rng = np.random.default_rng(
+            self.config.train.seed + self._refresh_count)
+        train_loss = float("nan")
+        for _ in range(self.stream.finetune_epochs):
+            self.state, train_loss = self.trainer.train_epoch(
+                self.state, bundle, data_rng)
+        eval_loss, _ = self.trainer.evaluate(self.state, bundle)
+
+        path = None
+        if self.ckpt_dir:
+            path = self.trainer.save(self.ckpt_dir, self.state, bundle)
+        self._pending = 0
+        self._refresh_count += 1
+        return RefreshResult(
+            refresh=self._refresh_count, num_buckets=self.num_buckets,
+            train_loss=train_loss, eval_loss=float(eval_loss),
+            checkpoint_path=path)
+
+    # -- resume ---------------------------------------------------------
+
+    def _maybe_resume(self) -> None:
+        """Adopt the latest checkpoint's frozen state (metric set, stats,
+        params) so a restarted stream continues rather than restarts."""
+        if not self.ckpt_dir:
+            return
+        from deeprest_tpu.train.checkpoint import latest_step
+
+        if latest_step(self.ckpt_dir) is None:
+            return
+        from deeprest_tpu.serve.predictor import Predictor
+
+        pred = Predictor.from_checkpoint(self.ckpt_dir)
+        if pred.model_config.feature_dim != self.space.capacity:
+            raise ValueError(
+                f"checkpoint feature_dim {pred.model_config.feature_dim} != "
+                f"stream capacity {self.space.capacity}")
+        self.metric_names = list(pred.metric_names)
+        self.x_stats = pred.x_stats
+        self.y_stats = pred.y_stats
+        model = dataclasses.replace(
+            self.config.model, feature_dim=pred.model_config.feature_dim,
+            num_metrics=len(pred.metric_names))
+        self.config = dataclasses.replace(self.config, model=model)
+        self.trainer = Trainer(self.config, model.feature_dim,
+                               self.metric_names)
+        target = self.trainer.init_state(np.zeros(
+            (1, self.config.train.window_size, model.feature_dim), np.float32))
+        from deeprest_tpu.train.checkpoint import restore_checkpoint
+
+        self.state, _ = restore_checkpoint(self.ckpt_dir, target)
+
+    # -- driver ---------------------------------------------------------
+
+    def run(self, tailer: BucketTailer,
+            max_refreshes: int | None = None,
+            should_stop: Callable[[], bool] | None = None,
+            deadline_s: float | None = None) -> Iterator[RefreshResult]:
+        """Poll the tailer forever (or until bounded), yielding one
+        RefreshResult per fine-tune cycle."""
+        t0 = time.monotonic()
+        while True:
+            if should_stop is not None and should_stop():
+                return
+            if deadline_s is not None and time.monotonic() - t0 > deadline_s:
+                return
+            for bucket in tailer.poll():
+                self.ingest(bucket)
+            if self.ready():
+                yield self.refresh()
+                if (max_refreshes is not None
+                        and self._refresh_count >= max_refreshes):
+                    return
+            else:
+                time.sleep(self.stream.poll_interval_s)
+
+
+__all__ = [
+    "BucketTailer", "StreamConfig", "StreamingTrainer", "RefreshResult",
+    "expand_minmax",
+]
