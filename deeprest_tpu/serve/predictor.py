@@ -38,6 +38,12 @@ class Predictor:
             lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
         )
 
+    @property
+    def model_config(self) -> ModelConfig:
+        """The restored architecture, as public API (equivalent to
+        ``self.model.config``, which is an implementation detail)."""
+        return self.model.config
+
     # ------------------------------------------------------------------
 
     @classmethod
@@ -51,12 +57,8 @@ class Predictor:
         explicitly passed config is trusted as-is — the caller owns both
         architecture and serving knobs (compute_dtype, rnn_backend).
         """
-        import dataclasses as dc
-        import json
-        import os
-
         from deeprest_tpu.train.checkpoint import (
-            _SIDECAR, _step_dir, latest_step, restore_checkpoint,
+            latest_step, load_sidecar, restore_checkpoint,
         )
         from deeprest_tpu.train.trainer import Trainer
 
@@ -64,9 +66,7 @@ class Predictor:
             step = latest_step(directory)
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {directory!r}")
-        with open(os.path.join(_step_dir(directory, step), _SIDECAR),
-                  encoding="utf-8") as f:
-            extra = json.load(f)
+        extra = load_sidecar(directory, step)
 
         if config is None:
             if "model_config" not in extra:

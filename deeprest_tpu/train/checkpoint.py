@@ -37,16 +37,40 @@ def save_checkpoint(directory: str, state: Any, step: int,
     return path
 
 
-def latest_step(directory: str) -> int | None:
+def list_steps(directory: str) -> list[int]:
+    """All checkpoint step numbers under ``directory``, ascending."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(m.group(1))
         for name in os.listdir(directory)
         if (m := _STEP_RE.match(name))
-    ]
-    return max(steps) if steps else None
+    )
+
+
+def latest_step(directory: str) -> int | None:
+    steps = list_steps(directory)
+    return steps[-1] if steps else None
+
+
+def load_sidecar(directory: str, step: int | None = None,
+                 missing_ok: bool = False) -> dict | None:
+    """Read one checkpoint's host-state sidecar without restoring arrays
+    (for consumers that only need metadata: metric names, stats, config).
+
+    ``missing_ok=True`` returns None for a sidecar-less step (e.g. a crash
+    between the orbax save and the sidecar write) instead of raising.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory!r}")
+    path = os.path.join(_step_dir(directory, step), _SIDECAR)
+    if missing_ok and not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
 
 
 def restore_checkpoint(directory: str, target: Any,
@@ -64,9 +88,4 @@ def restore_checkpoint(directory: str, target: Any,
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(path, abstract)
-    sidecar_path = os.path.join(path, _SIDECAR)
-    extra = None
-    if os.path.exists(sidecar_path):
-        with open(sidecar_path, "r", encoding="utf-8") as f:
-            extra = json.load(f)
-    return state, extra
+    return state, load_sidecar(directory, step, missing_ok=True)

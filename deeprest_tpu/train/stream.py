@@ -24,6 +24,30 @@ Design decisions, explicit because there is no reference behavior to match:
   monotone union keeps every checkpoint's de-normalization consistent with
   all earlier ones while still covering drifted ranges; windows are re-
   normalized with the current stats at every refresh.
+- **Per-feature traffic stats.**  The offline path fits one scalar min/max
+  over the whole traffic tensor (reference semantics,
+  resource-estimation/qrnn.py:69-75) — fine for a one-shot corpus, where a
+  hot column costs one normalization at worst.  Under the monotone-union
+  rule a scalar is a ratchet: a single traffic spike on one hash column
+  would permanently compress every other column's dynamic range.  Streaming
+  therefore fits min/max **per feature column** (shape ``[1, F]``; the
+  MinMaxStats contract is broadcast-shape-agnostic, so checkpoints,
+  serving, and resume are unaffected).  A hot endpoint then saturates only
+  its own column.  Columns whose observed range is degenerate get derived
+  *effective* stats — their own level if constant-nonzero, the global max
+  if never active — because MinMaxStats passes zero-range columns through
+  raw, which would feed unnormalized serve-time traffic to the model the
+  first time such a column activates.  The honest observed union is kept
+  separately (and persisted in the checkpoint sidecar) so a column that
+  merely goes quiet for one refresh is not misdetected as never-active
+  and ratcheted up to the global scale.
+- **Bounded refresh cost.**  ``refresh()`` re-windows and fine-tunes over
+  the retained corpus — deliberately *not* incremental, so every refresh
+  sees the newest normalization of the oldest data.  Cost is bounded by
+  ``history_max``: at most ``history_max - window_size`` windows ≈
+  ``finetune_epochs * history_max / batch_size`` train steps per refresh,
+  all at one static compiled shape (batch padding in Trainer._batches).
+  At defaults that is ≤ 256 steps per refresh, forever.
 - **Frozen metric set.**  The expert axis E is part of the compiled model.
   The metric set freezes at the first refresh; components that stop
   reporting fill with zeros, metrics that appear later are dropped (warned
@@ -49,7 +73,7 @@ import numpy as np
 from deeprest_tpu.config import Config, FeaturizeConfig
 from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.data.schema import Bucket
-from deeprest_tpu.data.windows import MinMaxStats, sliding_windows
+from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
 from deeprest_tpu.train.data import DatasetBundle
 from deeprest_tpu.train.trainer import Trainer, TrainState
 
@@ -66,13 +90,30 @@ class BucketTailer:
         self.path = path
         self._offset = 0
         self._carry = b""
+        self._ino: int | None = None
+        # Malformed complete lines are skipped, never wedge the stream — but
+        # visibly: counted here and logged, so a corrupted producer degrades
+        # to a diagnosable signal instead of silent "no data".
+        self.dropped = 0
 
     def poll(self) -> list[Bucket]:
         try:
-            size = os.path.getsize(self.path)
+            st = os.stat(self.path)
         except OSError:
             return []
-        if size <= self._offset:
+        size = st.st_size
+        if (self._ino is not None and st.st_ino != self._ino) \
+                or size < self._offset:
+            # Replaced (new inode) or truncated in place: the producer
+            # restarted/rotated the file. Re-read from the top rather than
+            # starving on — or tearing lines against — a stale offset.
+            print(f"stream: {self.path} was rotated "
+                  f"(inode {self._ino} -> {st.st_ino}, offset {self._offset}"
+                  f", size {size}); re-reading from start")
+            self._offset = 0
+            self._carry = b""
+        self._ino = st.st_ino
+        if size == self._offset:
             return []
         with open(self.path, "rb") as f:
             f.seek(self._offset)
@@ -89,7 +130,13 @@ class BucketTailer:
             try:
                 buckets.append(Bucket.from_dict(json.loads(line)))
             except (ValueError, KeyError, TypeError):
-                continue  # malformed line: skip, never wedge the stream
+                self.dropped += 1
+                # First drop verbatim, then every 1000th — a half-garbage
+                # backlog must not stall the poll loop on print I/O.
+                if self.dropped == 1 or self.dropped % 1000 == 0:
+                    print(f"stream: dropped malformed line "
+                          f"(total {self.dropped}) from {self.path} "
+                          f"({line[:80]!r})")
         return buckets
 
 
@@ -149,6 +196,9 @@ class StreamingTrainer:
         self.state: TrainState | None = None
         self.x_stats: MinMaxStats | None = None
         self.y_stats: MinMaxStats | None = None
+        # honest observed per-column traffic ranges (x_stats are derived
+        # from this each refresh — module docstring, per-feature stats)
+        self.x_union: MinMaxStats | None = None
         self._warned_new_metrics: set[str] = set()
         self._pending = 0
         self._refresh_count = 0
@@ -208,14 +258,27 @@ class StreamingTrainer:
         holdout = min(self.stream.eval_holdout, len(x) - 1)
         split = len(x) - holdout
 
-        # Expanding stats: union with every past refresh (monotone).
-        self.x_stats = expand_minmax(
-            self.x_stats, MinMaxStats(min=np.float32(x[:split].min()),
-                                      max=np.float32(x[:split].max())))
-        self.y_stats = expand_minmax(
-            self.y_stats,
-            MinMaxStats(min=y[:split].min(axis=(0, 1)).astype(np.float32),
-                        max=y[:split].max(axis=(0, 1)).astype(np.float32)))
+        # Expanding stats: union with every past refresh (monotone), fit
+        # per column — traffic per feature, targets per metric (module
+        # docstring: "Per-feature traffic stats").
+        self.x_union = expand_minmax(self.x_union,
+                                     minmax_fit(x, split, axis=(0, 1)))
+        self.y_stats = expand_minmax(self.y_stats,
+                                     minmax_fit(y, split, axis=(0, 1)))
+        # Effective traffic stats: degenerate columns would pass serve-time
+        # values through raw (MinMaxStats zero-range passthrough), so give
+        # them a usable scale — their own level if constant-nonzero, the
+        # global max if never active.  Derived from the honest union every
+        # refresh, so a column that merely went quiet keeps its own range.
+        union = self.x_union
+        degenerate = np.asarray(union.range == 0.0)
+        glob = np.float32(np.max(union.max))
+        self.x_stats = MinMaxStats(
+            min=np.where(degenerate, np.minimum(union.min, 0.0),
+                         union.min).astype(np.float32),
+            max=np.where(degenerate,
+                         np.where(union.max > 0, union.max, glob),
+                         union.max).astype(np.float32))
 
         x_n = self.x_stats.apply(x).astype(np.float32)
         y_n = self.y_stats.apply(y).astype(np.float32)
@@ -246,10 +309,18 @@ class StreamingTrainer:
         eval_loss, _ = self.trainer.evaluate(self.state, bundle)
 
         path = None
-        if self.ckpt_dir:
-            path = self.trainer.save(self.ckpt_dir, self.state, bundle)
         self._pending = 0
         self._refresh_count += 1
+        if self.ckpt_dir:
+            # The counter rides in the checkpoint sidecar so it is bound
+            # atomically to the step it describes — a crash can never leave
+            # counter and params disagreeing.
+            path = self.trainer.save(
+                self.ckpt_dir, self.state, bundle,
+                extra_host_state={
+                    "stream_refresh_count": self._refresh_count,
+                    "stream_x_union": self.x_union.to_dict(),
+                })
         return RefreshResult(
             refresh=self._refresh_count, num_buckets=self.num_buckets,
             train_loss=train_loss, eval_loss=float(eval_loss),
@@ -262,31 +333,51 @@ class StreamingTrainer:
         params) so a restarted stream continues rather than restarts."""
         if not self.ckpt_dir:
             return
-        from deeprest_tpu.train.checkpoint import latest_step
+        from deeprest_tpu.train.checkpoint import (
+            list_steps, load_sidecar, restore_checkpoint,
+        )
 
-        if latest_step(self.ckpt_dir) is None:
+        # Newest step with a readable sidecar: a crash between the orbax
+        # save and the sidecar write leaves an incomplete step dir, which
+        # must not wedge resume from the last complete one.
+        step = extra = None
+        for candidate in reversed(list_steps(self.ckpt_dir)):
+            extra = load_sidecar(self.ckpt_dir, candidate, missing_ok=True)
+            if extra is not None:
+                step = candidate
+                break
+            print(f"stream: checkpoint step {candidate} has no sidecar "
+                  "(crash mid-save?); falling back to the previous one")
+        if step is None:
             return
-        from deeprest_tpu.serve.predictor import Predictor
-
-        pred = Predictor.from_checkpoint(self.ckpt_dir)
-        if pred.model_config.feature_dim != self.space.capacity:
+        feature_dim = int(extra["feature_dim"])
+        if feature_dim != self.space.capacity:
             raise ValueError(
-                f"checkpoint feature_dim {pred.model_config.feature_dim} != "
+                f"checkpoint feature_dim {feature_dim} != "
                 f"stream capacity {self.space.capacity}")
-        self.metric_names = list(pred.metric_names)
-        self.x_stats = pred.x_stats
-        self.y_stats = pred.y_stats
+        self.metric_names = list(extra["metric_names"])
+        self.x_stats = MinMaxStats.from_dict(extra["x_stats"])
+        self.y_stats = MinMaxStats.from_dict(extra["y_stats"])
+        # Old checkpoints predate the honest union; effective stats are the
+        # closest available stand-in (slightly sticky for dead columns).
+        self.x_union = MinMaxStats.from_dict(
+            extra.get("stream_x_union", extra["x_stats"]))
         model = dataclasses.replace(
-            self.config.model, feature_dim=pred.model_config.feature_dim,
-            num_metrics=len(pred.metric_names))
+            self.config.model, feature_dim=feature_dim,
+            num_metrics=len(self.metric_names))
         self.config = dataclasses.replace(self.config, model=model)
-        self.trainer = Trainer(self.config, model.feature_dim,
-                               self.metric_names)
+        self.trainer = Trainer(self.config, feature_dim, self.metric_names)
         target = self.trainer.init_state(np.zeros(
-            (1, self.config.train.window_size, model.feature_dim), np.float32))
-        from deeprest_tpu.train.checkpoint import restore_checkpoint
-
-        self.state, _ = restore_checkpoint(self.ckpt_dir, target)
+            (1, self.config.train.window_size, feature_dim), np.float32))
+        self.state, _ = restore_checkpoint(self.ckpt_dir, target, step=step)
+        try:
+            self._refresh_count = int(extra.get("stream_refresh_count", 0))
+        except (TypeError, ValueError):
+            print("stream: checkpoint carries a malformed "
+                  "stream_refresh_count; numbering restarts at 0")
+        print(f"stream: resumed from {self.ckpt_dir} "
+              f"(refresh {self._refresh_count}, "
+              f"{len(self.metric_names)} metrics frozen)")
 
     # -- driver ---------------------------------------------------------
 
@@ -295,8 +386,15 @@ class StreamingTrainer:
             should_stop: Callable[[], bool] | None = None,
             deadline_s: float | None = None) -> Iterator[RefreshResult]:
         """Poll the tailer forever (or until bounded), yielding one
-        RefreshResult per fine-tune cycle."""
+        RefreshResult per fine-tune cycle.
+
+        ``max_refreshes`` bounds refreshes performed by *this* call — a
+        resumed stream's persisted lifetime counter affects numbering
+        only, so re-running the same bounded command always does the same
+        amount of work.
+        """
         t0 = time.monotonic()
+        performed = 0
         while True:
             if should_stop is not None and should_stop():
                 return
@@ -306,8 +404,8 @@ class StreamingTrainer:
                 self.ingest(bucket)
             if self.ready():
                 yield self.refresh()
-                if (max_refreshes is not None
-                        and self._refresh_count >= max_refreshes):
+                performed += 1
+                if max_refreshes is not None and performed >= max_refreshes:
                     return
             else:
                 time.sleep(self.stream.poll_interval_s)

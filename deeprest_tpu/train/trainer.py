@@ -235,8 +235,14 @@ class Trainer:
                 self.save(cfg.checkpoint_dir, state, bundle)
         return state, history
 
-    def save(self, directory: str, state: TrainState, bundle: DatasetBundle) -> str:
-        """Checkpoint the state plus the host-side stats needed to serve."""
+    def save(self, directory: str, state: TrainState, bundle: DatasetBundle,
+             extra_host_state: Mapping[str, Any] | None = None) -> str:
+        """Checkpoint the state plus the host-side stats needed to serve.
+
+        ``extra_host_state`` rides in the same sidecar, so caller state
+        (e.g. the streaming refresh counter) is atomically bound to the
+        step it describes.
+        """
         from deeprest_tpu.train.checkpoint import save_checkpoint
 
         extra = {
@@ -248,6 +254,13 @@ class Trainer:
             "model_config": dataclasses.asdict(self.model_config),
             "space": bundle.space_dict,
         }
+        if extra_host_state:
+            clash = set(extra_host_state) & set(extra)
+            if clash:
+                raise ValueError(
+                    f"extra_host_state would overwrite reserved sidecar "
+                    f"keys: {sorted(clash)}")
+            extra.update(extra_host_state)
         return save_checkpoint(directory, state, int(state.step), extra)
 
     # ------------------------------------------------------------------
