@@ -250,6 +250,67 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def cmd_export(args) -> int:
+    """Checkpoint → portable inference artifact (serve/export.py)."""
+    from deeprest_tpu.serve.export import export_predictor
+    from deeprest_tpu.serve.predictor import Predictor
+
+    pred = Predictor.from_checkpoint(args.ckpt_dir)
+    out = export_predictor(pred, args.out)
+    print(json.dumps({
+        "out": out,
+        "metrics": len(pred.metric_names),
+        "feature_dim": pred.feature_dim,
+        "window_size": pred.window_size,
+    }))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Serve predict / what-if / anomaly over HTTP from a checkpoint or an
+    exported artifact (serve/server.py)."""
+    from deeprest_tpu.serve.server import PredictionServer, PredictionService
+
+    if bool(args.ckpt_dir) == bool(args.artifact):
+        sys.exit("error: provide exactly one of --ckpt-dir or --artifact")
+    if args.ckpt_dir:
+        from deeprest_tpu.serve.predictor import Predictor
+
+        pred = Predictor.from_checkpoint(args.ckpt_dir)
+        backend = f"checkpoint:{args.ckpt_dir}"
+    else:
+        from deeprest_tpu.serve.export import ExportedPredictor
+
+        pred = ExportedPredictor.load(args.artifact)
+        backend = f"artifact:{args.artifact}"
+
+    synthesizer = None
+    if args.raw:
+        from deeprest_tpu.data.synthesize import TraceSynthesizer
+
+        space = pred.space()
+        if space is None:
+            sys.exit("error: model has no feature space; cannot fit the "
+                     "what-if synthesizer from --raw")
+        synthesizer = TraceSynthesizer(space).fit(_load_buckets(args.raw))
+
+    service = PredictionService(pred, synthesizer, backend=backend)
+    server = PredictionServer(service, host=args.host, port=args.port)
+    host, port = server.address
+    print(json.dumps({"listening": f"http://{host}:{port}",
+                      "backend": backend,
+                      "whatif": synthesizer is not None}), flush=True)
+    if args.deadline:
+        server.start()
+        import time as _time
+
+        _time.sleep(args.deadline)
+        server.stop()
+    else:
+        server.serve_forever()
+    return 0
+
+
 def _predictor(args):
     from deeprest_tpu.serve.predictor import Predictor
 
@@ -287,9 +348,9 @@ def _serving_traffic(args, pred) -> np.ndarray:
 
         traffic = featurize_buckets(_load_buckets(args.raw),
                                     space=space).traffic
-    if traffic.shape[1] != pred.model.config.feature_dim:
+    if traffic.shape[1] != pred.feature_dim:
         sys.exit(f"error: feature dim {traffic.shape[1]} != model "
-                 f"{pred.model.config.feature_dim}")
+                 f"{pred.feature_dim}")
     return traffic
 
 
@@ -335,9 +396,9 @@ def cmd_anomaly(args) -> int:
         data = featurize_buckets(_load_buckets(args.raw), space=space)
     if list(data.metric_names) != list(pred.metric_names):
         sys.exit("error: corpus metrics do not match the checkpoint's")
-    if data.traffic.shape[1] != pred.model.config.feature_dim:
+    if data.traffic.shape[1] != pred.feature_dim:
         sys.exit(f"error: feature dim {data.traffic.shape[1]} != model "
-                 f"{pred.model.config.feature_dim}")
+                 f"{pred.feature_dim}")
     detector = AnomalyDetector(pred, tolerance=args.tolerance,
                                min_run=args.min_run)
     reports = detector.check(data.traffic, data.targets())
@@ -431,6 +492,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline", type=float, default=0,
                    help="stop after this many seconds (0 = no deadline)")
     p.set_defaults(fn=cmd_stream)
+
+    p = sub.add_parser("export",
+                       help="checkpoint → portable inference artifact "
+                            "(jax.export StableHLO + JSON manifest)")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", required=True, help="artifact directory")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("serve",
+                       help="HTTP prediction service: predict / what-if / "
+                            "anomaly")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="serve the in-process predictor from this checkpoint")
+    p.add_argument("--artifact", default=None,
+                   help="serve the exported artifact from this directory")
+    p.add_argument("--raw", default=None,
+                   help="raw corpus to fit the what-if trace synthesizer")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2021)
+    p.add_argument("--deadline", type=float, default=0,
+                   help="stop after this many seconds (0 = run forever)")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")
     _add_input_args(p)

@@ -1,7 +1,23 @@
-"""Serving: trained-model prediction, what-if estimation, anomaly detection."""
+"""Serving: trained-model prediction, what-if estimation, anomaly detection,
+the portable export artifact, and the HTTP prediction service."""
 
-from deeprest_tpu.serve.predictor import Predictor
+from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
+from deeprest_tpu.serve.export import ExportedPredictor, export_predictor
+from deeprest_tpu.serve.server import (
+    PredictionServer, PredictionService, ServingError,
+)
 
-__all__ = ["Predictor", "WhatIfEstimator", "AnomalyDetector", "AnomalyReport"]
+__all__ = [
+    "Predictor",
+    "rolled_prediction",
+    "WhatIfEstimator",
+    "AnomalyDetector",
+    "AnomalyReport",
+    "ExportedPredictor",
+    "export_predictor",
+    "PredictionServer",
+    "PredictionService",
+    "ServingError",
+]

@@ -53,7 +53,7 @@ class AnomalyDetector:
         """traffic: [T, F] feature series; observed: [T, E] de-normalized
         utilization aligned with ``predictor.metric_names``."""
         preds = self.predictor.predict_series(traffic)      # [T, E, Q]
-        med = self.predictor.model.median_index()
+        med = self.predictor.median_index()
         for e, metric in enumerate(self.predictor.metric_names):
             resource = metric.rsplit("_", 1)[-1]
             if resource in self.reanchor_resources:

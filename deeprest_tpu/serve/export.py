@@ -1,0 +1,124 @@
+"""Portable inference artifact: checkpoint → serialized StableHLO on disk.
+
+The north star names this path explicitly ("predictor/ exports via jax2tf
+for the Go gRPC server" — BASELINE.json north_star; SURVEY.md §7.1 step 6):
+an inference artifact a non-JAX consumer can load.  TensorFlow is not in
+this image, so the artifact is ``jax.export``'s portable serialization —
+versioned StableHLO with the trained parameters baked in as constants and a
+*symbolic* batch dimension, executable by any PJRT-capable runtime (and by
+``jax.export.deserialize`` here).  Everything else a consumer needs —
+normalization statistics, metric names, quantiles, the call-path feature
+space — rides next to it in a plain-JSON manifest, so serving state cannot
+drift from training state (the same property Predictor gets from the
+checkpoint sidecar).
+
+Layout of an artifact directory::
+
+    model.stablehlo   serialized jax.export artifact  (binary)
+    manifest.json     stats + names + dims + model config  (JSON)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jexport
+
+from deeprest_tpu.data.windows import MinMaxStats
+from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
+
+ARTIFACT_BLOB = "model.stablehlo"
+ARTIFACT_MANIFEST = "manifest.json"
+_FORMAT = "jax.export/stablehlo"
+_PLATFORMS = ("cpu", "tpu")
+
+
+def export_predictor(pred: Predictor, directory: str) -> str:
+    """Serialize ``pred`` into ``directory`` (created if needed).
+
+    The exported computation is the deterministic forward pass on
+    *normalized* windows ``[b, W, F] -> [b, W, E, Q]`` with ``b``
+    symbolic, lowered for both cpu and tpu so one artifact serves on
+    either; normalization/de-normalization are host-side (manifest).
+    """
+    os.makedirs(directory, exist_ok=True)
+    (b,) = jexport.symbolic_shape("b")
+    spec = jax.ShapeDtypeStruct(
+        (b, pred.window_size, pred.feature_dim), jnp.float32)
+    fn = jax.jit(lambda x: pred.model.apply(
+        {"params": pred.params}, x, deterministic=True))
+    exported = jexport.export(fn, platforms=_PLATFORMS)(spec)
+    with open(os.path.join(directory, ARTIFACT_BLOB), "wb") as f:
+        f.write(exported.serialize())
+    manifest = {
+        "format": _FORMAT,
+        "platforms": list(_PLATFORMS),
+        "metric_names": pred.metric_names,
+        "window_size": pred.window_size,
+        "feature_dim": pred.feature_dim,
+        "quantiles": list(pred.quantiles),
+        "x_stats": pred.x_stats.to_dict(),
+        "y_stats": pred.y_stats.to_dict(),
+        "model_config": dataclasses.asdict(pred.model_config),
+        "space": pred.space_dict,
+    }
+    with open(os.path.join(directory, ARTIFACT_MANIFEST), "w",
+              encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return directory
+
+
+class ExportedPredictor:
+    """Drop-in serving backend loaded from an artifact directory.
+
+    Exposes the same serving protocol as :class:`Predictor`
+    (``predict_series``, ``metric_names``, ``window_size``, ``quantiles``,
+    ``feature_dim``, ``median_index``, ``space``), so AnomalyDetector,
+    WhatIfEstimator, and the HTTP server work unchanged on either backend.
+    """
+
+    def __init__(self, exported: jexport.Exported, manifest: dict):
+        if manifest.get("format") != _FORMAT:
+            raise ValueError(f"unknown artifact format {manifest.get('format')!r}")
+        self._exported = exported
+        self.manifest = manifest
+        self.metric_names: list[str] = list(manifest["metric_names"])
+        self.window_size: int = int(manifest["window_size"])
+        self.feature_dim: int = int(manifest["feature_dim"])
+        self.quantiles: tuple[float, ...] = tuple(manifest["quantiles"])
+        self.x_stats = MinMaxStats.from_dict(manifest["x_stats"])
+        self.y_stats = MinMaxStats.from_dict(manifest["y_stats"])
+        self.space_dict = manifest.get("space")
+
+    @classmethod
+    def load(cls, directory: str) -> "ExportedPredictor":
+        with open(os.path.join(directory, ARTIFACT_MANIFEST),
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+        with open(os.path.join(directory, ARTIFACT_BLOB), "rb") as f:
+            exported = jexport.deserialize(f.read())
+        return cls(exported, manifest)
+
+    def median_index(self) -> int:
+        diffs = [abs(q - 0.5) for q in self.quantiles]
+        return diffs.index(min(diffs))
+
+    def space(self):
+        """The training corpus's CallPathSpace (see Predictor.space)."""
+        if self.space_dict is None:
+            return None
+        from deeprest_tpu.data.featurize import CallPathSpace
+
+        return CallPathSpace.from_dict(self.space_dict)
+
+    def predict_series(self, traffic: np.ndarray) -> np.ndarray:
+        """[T, F] raw traffic → de-normalized [T, E, Q] predictions, same
+        tiling semantics as the in-process Predictor."""
+        return rolled_prediction(
+            self._exported.call, self.x_stats, self.y_stats,
+            self.window_size, traffic)

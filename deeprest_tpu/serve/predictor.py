@@ -18,6 +18,37 @@ from deeprest_tpu.data.windows import MinMaxStats
 from deeprest_tpu.models.qrnn import QuantileGRU
 
 
+def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
+                      window_size: int, traffic: np.ndarray) -> np.ndarray:
+    """[T, F] raw traffic → de-normalized [T, E, Q] predictions.
+
+    The series is tiled into non-overlapping windows (last window
+    right-aligned so every step is covered exactly once; the recurrent
+    core supports any duration — reference claim at
+    resource-estimation/README.md:83).  Shared by the in-process
+    Predictor and the exported-artifact loader so both serve identical
+    semantics by construction.
+    """
+    w = window_size
+    t = len(traffic)
+    if t < w:
+        raise ValueError(f"series length {t} < window_size {w}")
+    starts = list(range(0, t - w + 1, w))
+    if starts[-1] != t - w:
+        starts.append(t - w)
+    x = np.stack([traffic[s:s + w] for s in starts]).astype(np.float32)
+    x = x_stats.apply(x).astype(np.float32)
+    preds = np.asarray(apply_fn(x))                       # [N, W, E, Q]
+    preds = y_stats.invert(
+        np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
+    ).transpose(0, 1, 3, 2)
+
+    out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
+    for s, window in zip(starts, preds):
+        out[s:s + w] = window          # later (right-aligned) window wins
+    return out
+
+
 class Predictor:
     """Quantile predictions for traffic feature series."""
 
@@ -43,6 +74,21 @@ class Predictor:
         """The restored architecture, as public API (equivalent to
         ``self.model.config``, which is an implementation detail)."""
         return self.model.config
+
+    # The serving protocol shared with serve.export.ExportedPredictor —
+    # consumers (AnomalyDetector, WhatIfEstimator, the HTTP server) use
+    # only these, so either backend can sit behind them.
+
+    @property
+    def quantiles(self) -> tuple[float, ...]:
+        return self.model.config.quantiles
+
+    @property
+    def feature_dim(self) -> int:
+        return self.model.config.feature_dim
+
+    def median_index(self) -> int:
+        return self.model.median_index()
 
     # ------------------------------------------------------------------
 
@@ -106,28 +152,8 @@ class Predictor:
     # ------------------------------------------------------------------
 
     def predict_series(self, traffic: np.ndarray) -> np.ndarray:
-        """[T, F] raw traffic features → de-normalized [T, E, Q] predictions.
-
-        The series is tiled into non-overlapping windows (last window
-        right-aligned so every step is covered exactly once; the recurrent
-        core supports any duration — reference claim at
-        resource-estimation/README.md:83).
-        """
-        w = self.window_size
-        t = len(traffic)
-        if t < w:
-            raise ValueError(f"series length {t} < window_size {w}")
-        starts = list(range(0, t - w + 1, w))
-        if starts[-1] != t - w:
-            starts.append(t - w)
-        x = np.stack([traffic[s:s + w] for s in starts]).astype(np.float32)
-        x = self.x_stats.apply(x).astype(np.float32)
-        preds = np.asarray(self._apply(self.params, jnp.asarray(x)))
-        preds = self.y_stats.invert(
-            np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
-        ).transpose(0, 1, 3, 2)
-
-        out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
-        for s, window in zip(starts, preds):
-            out[s:s + w] = window          # later (right-aligned) window wins
-        return out
+        """[T, F] raw traffic features → de-normalized [T, E, Q] predictions
+        (see :func:`rolled_prediction` for the tiling semantics)."""
+        return rolled_prediction(
+            lambda x: self._apply(self.params, jnp.asarray(x)),
+            self.x_stats, self.y_stats, self.window_size, traffic)

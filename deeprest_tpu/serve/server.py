@@ -1,0 +1,265 @@
+"""The prediction service: predict / what-if / anomaly over HTTP.
+
+The reference's serving story is a Dash demo over a *precomputed* results
+pickle (reference: web-demo/app.py:13-16, dataloader.py:30-32) — no live
+model behind a wire.  This server is the missing piece the north star
+names (BASELINE.json: "... for the Go gRPC server"): a process any client
+can call with JSON over HTTP, backed by either the in-process Predictor
+(checkpoint) or the portable exported artifact (serve/export.py) — both
+expose the same serving protocol, so the wire format is identical.
+
+Routes (all JSON):
+
+    GET  /healthz             liveness + model dims
+    GET  /v1/meta             metric names, quantiles, window, endpoints
+    POST /v1/predict          {"traffic": [[F floats] x T]}          → [T,E,Q]
+    POST /v1/whatif           {"expected_traffic": [{endpoint: n}xT]} → series
+    POST /v1/whatif/scaling   {"baseline_traffic", "hypothetical_traffic"}
+    POST /v1/anomaly          {"traffic", "observed", "tolerance"?, "min_run"?}
+
+Built on the stdlib ThreadingHTTPServer: one small dependency-free binary
+surface, good enough for the sidecar role (the heavy lifting is one jit
+call per request; XLA serializes on the device anyway).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from deeprest_tpu.serve.anomaly import AnomalyDetector
+from deeprest_tpu.serve.whatif import WhatIfEstimator
+
+
+class ServingError(ValueError):
+    """Client error carrying an HTTP status."""
+
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+def _as_array(payload: dict, key: str, ndim: int) -> np.ndarray:
+    if key not in payload:
+        raise ServingError(f"missing field {key!r}")
+    try:
+        arr = np.asarray(payload[key], dtype=np.float32)
+    except (TypeError, ValueError) as e:
+        raise ServingError(f"field {key!r} is not numeric: {e}") from None
+    if arr.ndim != ndim:
+        raise ServingError(f"field {key!r} must be {ndim}-d, got {arr.ndim}-d")
+    return arr
+
+
+class PredictionService:
+    """Route handlers over a serving backend (Predictor or
+    ExportedPredictor) — transport-free, so tests can call it directly."""
+
+    def __init__(self, predictor, synthesizer=None, backend: str = ""):
+        self.predictor = predictor
+        self.backend = backend
+        self.whatif = (WhatIfEstimator(predictor, synthesizer)
+                       if synthesizer is not None else None)
+
+    # -- GET ------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "ok": True,
+            "backend": self.backend,
+            "num_metrics": len(self.predictor.metric_names),
+            "window_size": self.predictor.window_size,
+        }
+
+    def meta(self) -> dict:
+        return {
+            "backend": self.backend,
+            "metric_names": self.predictor.metric_names,
+            "quantiles": list(self.predictor.quantiles),
+            "window_size": self.predictor.window_size,
+            "feature_dim": self.predictor.feature_dim,
+            "whatif_endpoints": (self.whatif.endpoints
+                                 if self.whatif is not None else None),
+        }
+
+    # -- POST -----------------------------------------------------------
+
+    def _traffic_array(self, payload: dict) -> np.ndarray:
+        traffic = _as_array(payload, "traffic", 2)
+        if traffic.shape[1] != self.predictor.feature_dim:
+            raise ServingError(
+                f"traffic feature dim {traffic.shape[1]} != model "
+                f"{self.predictor.feature_dim}")
+        if len(traffic) < self.predictor.window_size:
+            raise ServingError(
+                f"traffic length {len(traffic)} < window_size "
+                f"{self.predictor.window_size}")
+        return traffic
+
+    def predict(self, payload: dict) -> dict:
+        traffic = self._traffic_array(payload)
+        preds = self.predictor.predict_series(traffic)        # [T, E, Q]
+        return {
+            "metric_names": self.predictor.metric_names,
+            "quantiles": list(self.predictor.quantiles),
+            "predictions": preds.tolist(),
+        }
+
+    def _require_whatif(self) -> WhatIfEstimator:
+        if self.whatif is None:
+            raise ServingError(
+                "what-if estimation unavailable: server started without a "
+                "corpus to fit the trace synthesizer (--raw)", status=503)
+        return self.whatif
+
+    def _traffic_program(self, payload: dict, key: str) -> list[dict]:
+        prog = payload.get(key)
+        if (not isinstance(prog, list) or not prog
+                or not all(isinstance(p, dict) for p in prog)):
+            raise ServingError(
+                f"field {key!r} must be a non-empty list of "
+                "{endpoint: count} objects")
+        if len(prog) < self.predictor.window_size:
+            raise ServingError(
+                f"{key!r} length {len(prog)} < window_size "
+                f"{self.predictor.window_size}")
+        return prog
+
+    @staticmethod
+    def _seed(payload: dict) -> int:
+        try:
+            return int(payload.get("seed", 0))
+        except (TypeError, ValueError) as e:
+            raise ServingError(f"bad seed: {e}") from None
+
+    def whatif_estimate(self, payload: dict) -> dict:
+        est = self._require_whatif()
+        prog = self._traffic_program(payload, "expected_traffic")
+        try:
+            series = est.estimate(prog, seed=self._seed(payload))
+        except KeyError as e:   # unknown endpoint in the traffic program
+            raise ServingError(str(e)) from None
+        return {"estimates": {
+            metric: {q: v.tolist() for q, v in bands.items()}
+            for metric, bands in series.items()
+        }}
+
+    def whatif_scaling(self, payload: dict) -> dict:
+        est = self._require_whatif()
+        base = self._traffic_program(payload, "baseline_traffic")
+        hypo = self._traffic_program(payload, "hypothetical_traffic")
+        try:
+            factors = est.scaling_factor(base, hypo, seed=self._seed(payload))
+        except KeyError as e:   # unknown endpoint in either program
+            raise ServingError(str(e)) from None
+        return {"scaling_factors": factors}
+
+    def anomaly(self, payload: dict) -> dict:
+        traffic = self._traffic_array(payload)
+        observed = _as_array(payload, "observed", 2)
+        if len(traffic) != len(observed):
+            raise ServingError("traffic and observed must have equal length")
+        if observed.shape[1] != len(self.predictor.metric_names):
+            raise ServingError(
+                f"observed has {observed.shape[1]} metrics, model has "
+                f"{len(self.predictor.metric_names)}")
+        try:
+            tolerance = float(payload.get("tolerance", 0.10))
+            min_run = int(payload.get("min_run", 5))
+        except (TypeError, ValueError) as e:
+            raise ServingError(f"bad tolerance/min_run: {e}") from None
+        detector = AnomalyDetector(self.predictor, tolerance=tolerance,
+                                   min_run=min_run)
+        reports = detector.check(traffic, observed)
+        return {"reports": [{
+            "metric": r.metric,
+            "score": r.score,
+            "flagged": r.flagged,
+            "first_flag_index": r.first_flag_index,
+        } for r in reports], "flagged": [r.metric for r in reports if r.flagged]}
+
+
+_GET_ROUTES = {"/healthz": "healthz", "/v1/meta": "meta"}
+_POST_ROUTES = {
+    "/v1/predict": "predict",
+    "/v1/whatif": "whatif_estimate",
+    "/v1/whatif/scaling": "whatif_scaling",
+    "/v1/anomaly": "anomaly",
+}
+
+
+class PredictionServer:
+    """ThreadingHTTPServer wrapper owning a PredictionService.
+
+    >>> srv = PredictionServer(service, port=0).start()
+    >>> ... http requests against srv.address ...
+    >>> srv.stop()
+    """
+
+    def __init__(self, service: PredictionService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            def _reply(self, status: int, body: dict):
+                blob = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                name = _GET_ROUTES.get(self.path)
+                if name is None:
+                    return self._reply(404, {"error": f"no route {self.path}"})
+                try:
+                    self._reply(200, getattr(outer.service, name)())
+                except Exception as e:  # never drop the connection silently
+                    self._reply(500, {"error": f"internal: {e}"})
+
+            def do_POST(self):
+                name = _POST_ROUTES.get(self.path)
+                if name is None:
+                    return self._reply(404, {"error": f"no route {self.path}"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ServingError("request body must be a JSON object")
+                    self._reply(200, getattr(outer.service, name)(payload))
+                except ServingError as e:
+                    self._reply(e.status, {"error": str(e)})
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": f"bad JSON: {e}"})
+                except Exception as e:  # handler bug: 500, not a dead socket
+                    self._reply(500, {"error": f"internal: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> "PredictionServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -19,10 +19,10 @@ class WhatIfEstimator:
     """Synthesizer + predictor, composed."""
 
     def __init__(self, predictor: Predictor, synthesizer: TraceSynthesizer):
-        if synthesizer.space.capacity != predictor.model.config.feature_dim:
+        if synthesizer.space.capacity != predictor.feature_dim:
             raise ValueError(
                 f"synthesizer capacity {synthesizer.space.capacity} != model "
-                f"feature_dim {predictor.model.config.feature_dim}"
+                f"feature_dim {predictor.feature_dim}"
             )
         self.predictor = predictor
         self.synthesizer = synthesizer
@@ -43,7 +43,7 @@ class WhatIfEstimator:
         """
         x = self.synthesizer.synthesize_series(expected_traffic, seed=seed)
         preds = self.predictor.predict_series(x)          # [T, E, Q]
-        quantiles = self.predictor.model.config.quantiles
+        quantiles = self.predictor.quantiles
         out: dict[str, dict[str, np.ndarray]] = {}
         for e, metric in enumerate(self.predictor.metric_names):
             out[metric] = {

@@ -32,8 +32,12 @@ def save_checkpoint(directory: str, state: Any, step: int,
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=True)
     if extra is not None:
-        with open(os.path.join(path, _SIDECAR), "w", encoding="utf-8") as f:
+        # tmp + rename: a crash mid-write must leave no torn sidecar (a
+        # torn one would wedge every consumer that reads it at startup)
+        sidecar = os.path.join(path, _SIDECAR)
+        with open(sidecar + ".tmp", "w", encoding="utf-8") as f:
             json.dump(extra, f, indent=2, sort_keys=True)
+        os.replace(sidecar + ".tmp", sidecar)
     return path
 
 
@@ -59,8 +63,9 @@ def load_sidecar(directory: str, step: int | None = None,
     """Read one checkpoint's host-state sidecar without restoring arrays
     (for consumers that only need metadata: metric names, stats, config).
 
-    ``missing_ok=True`` returns None for a sidecar-less step (e.g. a crash
-    between the orbax save and the sidecar write) instead of raising.
+    ``missing_ok=True`` returns None for a sidecar that is absent *or
+    unparseable* (e.g. a crash between the orbax save and the sidecar
+    write, or torn by a pre-atomic-write version) instead of raising.
     """
     if step is None:
         step = latest_step(directory)
@@ -69,8 +74,13 @@ def load_sidecar(directory: str, step: int | None = None,
     path = os.path.join(_step_dir(directory, step), _SIDECAR)
     if missing_ok and not os.path.exists(path):
         return None
-    with open(path, "r", encoding="utf-8") as f:
-        return json.load(f)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except ValueError:
+        if missing_ok:
+            return None
+        raise
 
 
 def restore_checkpoint(directory: str, target: Any,

@@ -1,0 +1,258 @@
+"""Export artifact + HTTP prediction service: round-trip parity between the
+in-process Predictor and the serialized jax.export artifact, and the full
+predict / what-if / anomaly wire (BASELINE.json north_star: "predictor/
+exports ... for the ... gRPC server"; SURVEY.md §7.1 step 6)."""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
+from deeprest_tpu.data.featurize import CallPathSpace, featurize_buckets
+from deeprest_tpu.data.synthesize import TraceSynthesizer
+from deeprest_tpu.serve import (
+    ExportedPredictor, PredictionServer, PredictionService, Predictor,
+    export_predictor,
+)
+from deeprest_tpu.train import Trainer, prepare_dataset
+from deeprest_tpu.workload import Anomaly, crypto_scenario, normal_scenario, simulate_corpus
+
+CFG = Config(
+    model=ModelConfig(hidden_size=8, dropout_rate=0.1),
+    train=TrainConfig(num_epochs=4, batch_size=16, window_size=12,
+                      eval_stride=12, eval_max_cycles=3, seed=0),
+)
+
+COMPOSE = "nginx-thrift_/wrk2-api/post/compose"
+READ = "nginx-thrift_/wrk2-api/home-timeline/read"
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """Small trained model + its export artifact + corpus pieces."""
+    scn = normal_scenario(0)
+    scn.calls_per_user = 0.3
+    corpus = simulate_corpus(scn, 150)
+    space = CallPathSpace(config=FeaturizeConfig(round_to=8))
+    data = featurize_buckets(corpus, space=space)
+    bundle = prepare_dataset(data, CFG.train)
+    trainer = Trainer(CFG, bundle.feature_dim, bundle.metric_names)
+    state, _ = trainer.fit(bundle)
+    ckpt_dir = str(tmp_path_factory.mktemp("ckpt"))
+    trainer.save(ckpt_dir, state, bundle)
+    pred = Predictor.from_checkpoint(ckpt_dir)
+    artifact_dir = export_predictor(
+        pred, str(tmp_path_factory.mktemp("artifact")))
+    return dict(corpus=corpus, space=space, data=data, bundle=bundle,
+                ckpt_dir=ckpt_dir, pred=pred, artifact_dir=artifact_dir)
+
+
+# ---------------------------------------------------------------------------
+# Artifact round-trip
+
+def test_artifact_files_on_disk(world):
+    import os
+
+    assert os.path.isfile(os.path.join(world["artifact_dir"], "model.stablehlo"))
+    with open(os.path.join(world["artifact_dir"], "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == "jax.export/stablehlo"
+    assert "tpu" in manifest["platforms"] and "cpu" in manifest["platforms"]
+    assert manifest["metric_names"] == world["pred"].metric_names
+
+
+def test_exported_predictor_parity(world):
+    """The serialized artifact must reproduce the in-process predictor's
+    de-normalized outputs on identical inputs (round-trip parity)."""
+    exported = ExportedPredictor.load(world["artifact_dir"])
+    pred = world["pred"]
+    assert exported.metric_names == pred.metric_names
+    assert exported.window_size == pred.window_size
+    assert exported.quantiles == pred.quantiles
+    assert exported.median_index() == pred.median_index()
+    for length in (36, 31):        # window-multiple and right-aligned tail
+        traffic = world["data"].traffic[:length]
+        np.testing.assert_allclose(
+            exported.predict_series(traffic), pred.predict_series(traffic),
+            rtol=1e-5, atol=1e-5)
+
+
+def test_exported_space_roundtrips(world):
+    exported = ExportedPredictor.load(world["artifact_dir"])
+    space = exported.space()
+    assert space is not None
+    assert space.capacity == exported.feature_dim
+
+
+# ---------------------------------------------------------------------------
+# HTTP service
+
+class Client:
+    def __init__(self, addr):
+        self.host, self.port = addr
+
+    def request(self, method, path, payload=None):
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        body = json.dumps(payload).encode() if payload is not None else None
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        conn.close()
+        return resp.status, out
+
+
+@pytest.fixture(scope="module")
+def served(world):
+    """One server per backend: in-process checkpoint and exported artifact,
+    both with a fitted what-if synthesizer."""
+    servers = {}
+    synth = TraceSynthesizer(world["space"]).fit(world["corpus"])
+    for name, backend in (
+        ("checkpoint", world["pred"]),
+        ("artifact", ExportedPredictor.load(world["artifact_dir"])),
+    ):
+        service = PredictionService(backend, synth, backend=name)
+        servers[name] = PredictionServer(service, port=0).start()
+    yield {name: Client(s.address) for name, s in servers.items()}
+    for s in servers.values():
+        s.stop()
+
+
+@pytest.mark.parametrize("backend", ["checkpoint", "artifact"])
+def test_healthz_and_meta(served, world, backend):
+    client = served[backend]
+    status, body = client.request("GET", "/healthz")
+    assert status == 200 and body["ok"] and body["backend"] == backend
+    status, meta = client.request("GET", "/v1/meta")
+    assert status == 200
+    assert meta["metric_names"] == world["pred"].metric_names
+    assert COMPOSE in meta["whatif_endpoints"]
+
+
+@pytest.mark.parametrize("backend", ["checkpoint", "artifact"])
+def test_predict_over_the_wire_matches_in_process(served, world, backend):
+    traffic = world["data"].traffic[:31]
+    status, body = served[backend].request(
+        "POST", "/v1/predict", {"traffic": traffic.tolist()})
+    assert status == 200
+    wire = np.asarray(body["predictions"], np.float32)
+    np.testing.assert_allclose(
+        wire, world["pred"].predict_series(traffic), rtol=1e-4, atol=1e-4)
+    assert body["metric_names"] == world["pred"].metric_names
+
+
+def test_whatif_over_the_wire(served, world):
+    prog = [{COMPOSE: 10, READ: 30}] * 24
+    status, body = served["artifact"].request(
+        "POST", "/v1/whatif", {"expected_traffic": prog, "seed": 0})
+    assert status == 200
+    ests = body["estimates"]
+    assert set(ests) == set(world["pred"].metric_names)
+    q50 = ests["nginx-thrift_cpu"]["q50"]
+    assert len(q50) == 24 and np.isfinite(q50).all()
+
+    status, body = served["artifact"].request(
+        "POST", "/v1/whatif/scaling",
+        {"baseline_traffic": prog,
+         "hypothetical_traffic": [{COMPOSE: 30, READ: 90}] * 24})
+    assert status == 200
+    assert body["scaling_factors"]["nginx-thrift_cpu"] > 0.9
+
+
+def test_anomaly_over_the_wire_flags_cryptojack(served, world):
+    victim = "compose-post-service"
+    scn = crypto_scenario(9)
+    scn.calls_per_user = 0.3
+    bad = simulate_corpus(scn, 80, anomalies=[
+        Anomaly(kind="cryptojacking", component=victim, start=30, end=60)])
+    bad_data = featurize_buckets(bad, space=world["space"])
+    observed = np.stack(
+        [bad_data.resources[m] for m in world["bundle"].metric_names], -1)
+    status, body = served["artifact"].request(
+        "POST", "/v1/anomaly",
+        {"traffic": bad_data.traffic.tolist(),
+         "observed": observed.tolist(), "tolerance": 0.10, "min_run": 5})
+    assert status == 200
+    assert f"{victim}_cpu" in body["flagged"]
+    by_metric = {r["metric"]: r for r in body["reports"]}
+    assert by_metric[f"{victim}_cpu"]["first_flag_index"] is not None
+
+
+def test_wire_error_paths(served, world):
+    client = served["checkpoint"]
+    status, body = client.request("POST", "/v1/predict", {"traffic": [[1, 2]]})
+    assert status == 400 and "feature dim" in body["error"]
+    status, body = client.request("POST", "/v1/predict", {})
+    assert status == 400 and "traffic" in body["error"]
+    # anomaly validates traffic like predict (short series → 400, not a
+    # dropped connection), and bad knob types are 400 too
+    F = world["pred"].feature_dim
+    E = len(world["pred"].metric_names)
+    status, body = client.request(
+        "POST", "/v1/anomaly",
+        {"traffic": np.zeros((3, F)).tolist(),
+         "observed": np.zeros((3, E)).tolist()})
+    assert status == 400 and "window_size" in body["error"]
+    W = world["pred"].window_size
+    status, body = client.request(
+        "POST", "/v1/anomaly",
+        {"traffic": np.zeros((W, F)).tolist(),
+         "observed": np.zeros((W, E)).tolist(), "tolerance": "hot"})
+    assert status == 400 and "tolerance" in body["error"]
+    # unknown what-if endpoint is a client error
+    status, body = client.request("POST", "/v1/whatif",
+                                  {"expected_traffic": [{"x": 1}] * 12})
+    assert status == 400 and "unknown API endpoint" in body["error"]
+    status, body = client.request("POST", "/v1/nope", {})
+    assert status == 404
+    status, body = client.request("GET", "/v1/nope")
+    assert status == 404
+    # whatif without a synthesizer → 503
+    service = PredictionService(world["pred"], None, backend="bare")
+    bare = PredictionServer(service, port=0).start()
+    try:
+        status, body = Client(bare.address).request(
+            "POST", "/v1/whatif",
+            {"expected_traffic": [{COMPOSE: 1}] * 12})
+        assert status == 503
+    finally:
+        bare.stop()
+
+
+def test_handler_bug_yields_500_not_dead_socket(world):
+    class ExplodingBackend:
+        metric_names = ["m_cpu"]
+        window_size = 2
+        feature_dim = 2
+        quantiles = (0.05, 0.5, 0.95)
+
+        def predict_series(self, traffic):
+            raise RuntimeError("kaboom")
+
+    srv = PredictionServer(
+        PredictionService(ExplodingBackend(), None, backend="stub"),
+        port=0).start()
+    try:
+        status, body = Client(srv.address).request(
+            "POST", "/v1/predict", {"traffic": [[0, 0]] * 4})
+        assert status == 500 and "kaboom" in body["error"]
+    finally:
+        srv.stop()
+
+
+def test_cli_export_subcommand(world, tmp_path, capsys):
+    from deeprest_tpu.cli import main
+
+    out = str(tmp_path / "artifact")
+    assert main(["export", "--ckpt-dir", world["ckpt_dir"],
+                 "--out", out]) == 0
+    info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert info["out"] == out
+    exported = ExportedPredictor.load(out)
+    traffic = world["data"].traffic[:24]
+    np.testing.assert_allclose(
+        exported.predict_series(traffic),
+        world["pred"].predict_series(traffic), rtol=1e-5, atol=1e-5)
