@@ -155,3 +155,66 @@ def test_bfloat16_compute_path():
     out32 = QuantileGRU(config=f32_cfg).apply(variables, x)
     # bf16 matmuls drift but stay in the same ballpark
     np.testing.assert_allclose(np.asarray(out), np.asarray(out32), atol=0.1)
+
+
+def test_full_model_torch_weight_transplant_parity():
+    """Pin the whole architecture to the reference: transplant every weight
+    of the reference-equivalent torch model (mask MLP + bidirectional GRU +
+    mixing + quantile heads — resource-estimation/qrnn.py:28-67) into
+    QuantileGRU and require equal forward outputs AND equal pinball loss.
+    Op-level GRU parity lives in test_ops.py; this is the end-to-end pin."""
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    from benchmarks.baseline_torch import TorchQuantileRNN
+
+    from deeprest_tpu.ops import pinball_loss
+
+    B, T, F, E, H = 2, 9, 6, 3, 4
+    torch.manual_seed(3)
+    tmodel = TorchQuantileRNN(F, E, hidden=H).eval()
+
+    cfg = ModelConfig(feature_dim=F, num_metrics=E, hidden_size=H,
+                      dropout_rate=0.0)
+    model, variables, _ = init_model(cfg)
+    params = dict(variables["params"])
+
+    def t(arr):
+        return jnp.asarray(arr.detach().numpy())
+
+    def stack(fn):
+        return jnp.stack([fn(e) for e in tmodel.experts])
+
+    params["mask_w1"] = stack(lambda e: t(e.mask_in.weight)[:, 0])
+    params["mask_b1"] = stack(lambda e: t(e.mask_in.bias))
+    params["mask_w2"] = stack(lambda e: t(e.mask_out.weight).T)
+    params["mask_b2"] = stack(lambda e: t(e.mask_out.bias))
+    for jax_name, torch_sfx in (("gru_fwd", ""), ("gru_bwd", "_reverse")):
+        params[f"{jax_name}_w_ih"] = stack(
+            lambda e: t(getattr(e.rnn, f"weight_ih_l0{torch_sfx}")).T)
+        params[f"{jax_name}_w_hh"] = stack(
+            lambda e: t(getattr(e.rnn, f"weight_hh_l0{torch_sfx}")).T)
+        params[f"{jax_name}_b_ih"] = stack(
+            lambda e: t(getattr(e.rnn, f"bias_ih_l0{torch_sfx}")))
+        params[f"{jax_name}_b_hh"] = stack(
+            lambda e: t(getattr(e.rnn, f"bias_hh_l0{torch_sfx}")))
+    params["head_w"] = stack(lambda e: t(e.head.weight).T)
+    params["head_b"] = stack(lambda e: t(e.head.bias))
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    y = rng.normal(size=(B, T, E)).astype(np.float32)
+
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                  deterministic=True))
+    with torch.no_grad():
+        theirs = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    # Loss-formula equivalence, pinned on the SAME prediction tensor so the
+    # tolerance is independent of the forward-parity budget above.
+    our_loss = float(pinball_loss(jnp.asarray(theirs), jnp.asarray(y),
+                                  cfg.quantiles))
+    their_loss = float(tmodel.loss(torch.from_numpy(theirs),
+                                   torch.from_numpy(y)))
+    np.testing.assert_allclose(our_loss, their_loss, rtol=1e-5)
