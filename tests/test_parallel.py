@@ -117,3 +117,62 @@ def test_flagship_shape_sharded_step():
         mesh, num_metrics=40, feature_dim=512, window=60, batch=32,
         hidden=128, bf16=True, rnn_backend="scan")
     assert np.isfinite(loss) and np.isfinite(test_loss)
+
+
+def test_ten_k_endpoint_width_sharded_correctness():
+    """The 10k-endpoint config (BASELINE.json configs[3]): hash-mode width
+    F=10240 at flagship H=128 with a NON-TRIVIAL model (TP) axis — the
+    sharding pressure point SURVEY.md §7.3 names (per-expert mask
+    Linear(128->F) and GRU input projections grow with the endpoint
+    vocabulary). Sharded training must match the single-device run."""
+    from __graft_entry__ import _flagship_config
+
+    F10K, E, H, W, B = 10240, 4, 128, 8, 8
+    cfg = _flagship_config(feature_dim=F10K, num_metrics=E, hidden=H,
+                           bf16=False)
+    import dataclasses
+
+    cfg = cfg.replace(
+        model=dataclasses.replace(cfg.model, rnn_backend="scan",
+                                  dropout_rate=0.0),
+        train=dataclasses.replace(cfg.train, batch_size=B, window_size=W,
+                                  eval_stride=W, eval_max_cycles=2,
+                                  log_every_steps=0))
+    rng = np.random.default_rng(0)
+    names = [f"c{i}_cpu" for i in range(E)]
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.train.data import DatasetBundle
+
+    bundle_10k = DatasetBundle(
+        x_train=rng.random((B, W, F10K)).astype(np.float32),
+        y_train=rng.random((B, W, E)).astype(np.float32),
+        x_test=rng.random((2 * W, W, F10K)).astype(np.float32),
+        y_test=rng.random((2 * W, W, E)).astype(np.float32),
+        x_stats=MinMaxStats(min=np.float32(0), max=np.float32(1)),
+        y_stats=MinMaxStats(min=np.zeros((1, E), np.float32),
+                            max=np.ones((1, E), np.float32)),
+        metric_names=names, split=B, window_size=W)
+
+    # model=4 actually splits the F=10240 axis four ways (2560/device)
+    multi = Trainer(cfg, F10K, names,
+                    mesh=make_mesh(MeshConfig(data=2, expert=1, model=4)))
+    m_state = multi.init_state(bundle_10k.x_train)
+    assert m_state.params["gru_fwd_w_ih"].shape == (E, F10K, 3 * H)
+    shard_shape = m_state.params["gru_fwd_w_ih"].sharding.shard_shape(
+        (E, F10K, 3 * H))
+    assert shard_shape[1] == F10K // 4          # TP really splits F
+    m_state, m_loss = multi.train_epoch(m_state, bundle_10k,
+                                        np.random.default_rng(1))
+    m_eval, _ = multi.evaluate(m_state, bundle_10k)
+
+    single = Trainer(cfg, F10K, names, mesh=make_mesh(MeshConfig()))
+    s_state = single.init_state(bundle_10k.x_train)
+    s_state, s_loss = single.train_epoch(s_state, bundle_10k,
+                                         np.random.default_rng(1))
+    s_eval, _ = single.evaluate(s_state, bundle_10k)
+
+    np.testing.assert_allclose(m_loss, s_loss, rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(m_eval, s_eval, rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(m_state.params["mask_w2"]),
+        np.asarray(s_state.params["mask_w2"]), rtol=5e-3, atol=1e-4)
