@@ -74,6 +74,10 @@ class SnsCluster:
     def __init__(self, out_path: str, interval_ms: int = 5000,
                  grace_ms: int = 1000, verbose: bool = False,
                  data_dir: str | None = None):
+        # Collector /metrics + dashboard port, allocated at start()
+        # (the reference's Prometheus scrape surface,
+        # monitor-openebs-pg.yaml:38-173).
+        self.metrics_addr: tuple[str, int] | None = None
         self.out_path = os.path.abspath(out_path)
         self.interval_ms = interval_ms
         self.grace_ms = grace_ms
@@ -107,7 +111,8 @@ class SnsCluster:
         if not snsd_available():
             raise RuntimeError(f"snsd not built at {snsd_path()} (make -C native/sns)")
         named = list(STORES) + list(SERVICES) + list(GATEWAYS) + [COLLECTOR]
-        ports = _free_ports(len(named))
+        ports = _free_ports(len(named) + 1)
+        self.metrics_addr = ("127.0.0.1", ports.pop())
         self.components = {c: ("127.0.0.1", p) for c, p in zip(named, ports)}
 
         self._config_path = self.out_path + ".cluster.json"
@@ -122,6 +127,7 @@ class SnsCluster:
                 f"--out={self.out_path}",
                 f"--interval-ms={self.interval_ms}",
                 f"--grace-ms={self.grace_ms}",
+                f"--metrics-port={self.metrics_addr[1]}",
             ])
             for c in STORES:
                 self._spawn(c)

@@ -42,6 +42,7 @@ from deeprest_tpu.loadgen.cluster import (  # noqa: E402
 
 NAMESPACE = "deeprest-sns"
 PORT = 9090
+METRICS_PORT = 9464          # collector /metrics + /dashboard
 GATEWAY_NODEPORT = 31000
 
 # Dataflow edges (who calls whom) for the INPUT/OUTPUT pod labels; derived
@@ -126,11 +127,15 @@ def pvc(name: str, size: str = "2Gi") -> dict:
     }
 
 
-def service(name: str, nodeport: int | None = None) -> dict:
+def service(name: str, nodeport: int | None = None,
+            metrics_port: int | None = None) -> dict:
     spec: dict = {
         "selector": {"app": name},
         "ports": [{"name": "rpc", "port": PORT, "targetPort": PORT}],
     }
+    if metrics_port is not None:
+        spec["ports"].append({"name": "metrics", "port": metrics_port,
+                              "targetPort": metrics_port})
     if nodeport is not None:
         spec["type"] = "NodePort"
         spec["ports"][0]["nodePort"] = nodeport
@@ -140,7 +145,8 @@ def service(name: str, nodeport: int | None = None) -> dict:
 
 def deployment(name: str, image: str, replicas: int = 1,
                extra_args: list[str] | None = None,
-               with_pvc: bool = False) -> dict:
+               with_pvc: bool = False,
+               metrics_port: int | None = None) -> dict:
     labels = {f"OUTPUT{i + 1}": dst
               for i, dst in enumerate(EDGES.get(name, ()))}
     labels.update({f"INPUT{i + 1}": src
@@ -154,10 +160,25 @@ def deployment(name: str, image: str, replicas: int = 1,
         volumes.append({"name": "data",
                         "persistentVolumeClaim": {"claimName": f"{name}-pvc"}})
         mounts.append({"name": "data", "mountPath": "/var/lib/deeprest"})
+    ports = [{"containerPort": PORT}]
+    template_meta: dict = {"labels": {"app": name,
+                                      "plane": "deeprest-sns", **labels}}
+    if metrics_port is not None:
+        # Prometheus discovery via the standard scrape annotations (the
+        # reference configures explicit scrape jobs instead,
+        # monitor-openebs-pg.yaml:60,91,142 — annotations are the
+        # k8s-native equivalent for a single exporter).
+        args.append(f"--metrics-port={metrics_port}")
+        ports.append({"containerPort": metrics_port, "name": "metrics"})
+        template_meta["annotations"] = {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": str(metrics_port),
+            "prometheus.io/path": "/metrics",
+        }
     container = {
         "name": name, "image": image,
         "command": ["/usr/local/bin/snsd"], "args": args,
-        "ports": [{"containerPort": PORT}],
+        "ports": ports,
         "volumeMounts": mounts,
         "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}},
     }
@@ -168,8 +189,7 @@ def deployment(name: str, image: str, replicas: int = 1,
             "replicas": replicas,
             "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {"app": name,
-                                        "plane": "deeprest-sns", **labels}},
+                "metadata": template_meta,
                 "spec": {"containers": [container], "volumes": volumes,
                          "restartPolicy": "Always"},
             },
@@ -222,10 +242,11 @@ def generate(image: str) -> dict[str, list[dict]]:
     ]
     files["consumer.yaml"] = [service(CONSUMER), deployment(CONSUMER, image)]
     files["collector.yaml"] = [
-        service(COLLECTOR),
+        service(COLLECTOR, metrics_port=METRICS_PORT),
         deployment(COLLECTOR, image, with_pvc=True,
                    extra_args=["--out=/var/lib/deeprest/raw_data.jsonl",
-                               "--interval-ms=5000"]),
+                               "--interval-ms=5000"],
+                   metrics_port=METRICS_PORT),
     ]
     files["loadgen-job.yaml"] = [loadgen_job(image)]
     return files

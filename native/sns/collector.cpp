@@ -13,6 +13,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include "http.h"
+
 #include <algorithm>
 #include <chrono>
 #include <fstream>
@@ -131,6 +133,7 @@ void Collector::Ingest(const Json& frame) {
   }
   uint64_t now = NowNs();
   std::lock_guard<std::mutex> lock(mu_);
+  spans_ingested_ += frame.as_array().size();
   for (const auto& j : frame.as_array()) {
     SpanRecord s;
     s.trace_id = j["tid"].as_uint();
@@ -210,6 +213,7 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
         m["resource"] = Json(resource);
         m["value"] = Json(value);
         metrics.push_back(Json(std::move(m)));
+        latest_[{component, resource}] = value;  // /metrics gauge snapshot
       };
       auto prev = last_samples_.find(component);
       bool have_delta = now.ok && prev != last_samples_.end() &&
@@ -251,6 +255,8 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
     m["resource"] = Json("usage");
     m["value"] = Json(usage_mb);
     metrics.push_back(Json(std::move(m)));
+    std::lock_guard<std::mutex> lock(mu_);
+    latest_[{component, "usage"}] = usage_mb;
   }
 
   // -- trace assembly: traces whose root ended inside [t0, t1) and that
@@ -274,10 +280,12 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
         }
       if (!has_root) {
         // Rootless after grace: drop after a generous TTL.
-        if (now - t.last_update_ns > 30ull * 1000000000ull)
+        if (now - t.last_update_ns > 30ull * 1000000000ull) {
+          ++traces_dropped_rootless_;
           it = pending_.erase(it);
-        else
+        } else {
           ++it;
+        }
         continue;
       }
       if (root_end >= t1_ns) {  // belongs to a future bucket
@@ -285,9 +293,13 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
         continue;
       }
       Json tree = SpanTreeToJson(t.spans);
-      if (!tree.is_null()) traces.push_back(std::move(tree));
+      if (!tree.is_null()) {
+        ++traces_assembled_;
+        traces.push_back(std::move(tree));
+      }
       it = pending_.erase(it);
     }
+    ++buckets_written_;
   }
 
   JsonObject bucket;
@@ -298,8 +310,119 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
   return Json(std::move(bucket));
 }
 
+std::string Collector::MetricsText() {
+  std::ostringstream out;
+  std::lock_guard<std::mutex> lock(mu_);
+  out << "# HELP deeprest_resource Latest per-component resource sample "
+         "(cpu=millicores, memory=MB RSS, write-iops=/s, write-tp=KB/s, "
+         "usage=MB logical).\n"
+      << "# TYPE deeprest_resource gauge\n";
+  for (const auto& [key, value] : latest_)
+    out << "deeprest_resource{component=\"" << key.first << "\",resource=\""
+        << key.second << "\"} " << value << "\n";
+  out << "# HELP deeprest_spans_ingested_total Spans received from service "
+         "sinks.\n"
+      << "# TYPE deeprest_spans_ingested_total counter\n"
+      << "deeprest_spans_ingested_total " << spans_ingested_ << "\n"
+      << "# TYPE deeprest_traces_assembled_total counter\n"
+      << "deeprest_traces_assembled_total " << traces_assembled_ << "\n"
+      << "# TYPE deeprest_traces_dropped_rootless_total counter\n"
+      << "deeprest_traces_dropped_rootless_total " << traces_dropped_rootless_
+      << "\n"
+      << "# TYPE deeprest_buckets_written_total counter\n"
+      << "deeprest_buckets_written_total " << buckets_written_ << "\n"
+      << "# HELP deeprest_pending_traces Traces awaiting grace/assembly.\n"
+      << "# TYPE deeprest_pending_traces gauge\n"
+      << "deeprest_pending_traces " << pending_.size() << "\n";
+  return out.str();
+}
+
+namespace {
+
+// The minimal live dashboard: polls /metrics and renders per-component
+// gauges — the process-cluster stand-in for the reference's Grafana board
+// (openebs-pg-dashboard.json).
+constexpr const char* kDashboardHtml = R"html(<!doctype html>
+<html><head><meta charset="utf-8"><title>deeprest cluster</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}
+table{border-collapse:collapse;background:#fff;box-shadow:0 1px 3px #0002}
+th,td{padding:.35em .8em;border-bottom:1px solid #eee;text-align:right}
+th{background:#f0f0f0}td:first-child,th:first-child{text-align:left}
+caption{font-weight:600;margin-bottom:.5em;text-align:left}
+#counters{margin:1em 0;color:#555}</style></head><body>
+<h2>deeprest live cluster</h2><div id="counters">loading…</div>
+<table><caption>Latest scrape (per component)</caption><thead>
+<tr><th>component</th><th>cpu (mc)</th><th>mem (MB)</th><th>w-iops</th>
+<th>w-tp (KB/s)</th><th>usage (MB)</th></tr></thead>
+<tbody id="rows"></tbody></table>
+<script>
+const RES=["cpu","memory","write-iops","write-tp","usage"];
+async function tick(){
+  const text=await (await fetch("/metrics")).text();
+  const comps={},counters=[];
+  for(const line of text.split("\n")){
+    let m=line.match(/^deeprest_resource\{component="([^"]+)",resource="([^"]+)"\} (.*)$/);
+    if(m){(comps[m[1]]=comps[m[1]]||{})[m[2]]=parseFloat(m[3]);continue;}
+    m=line.match(/^deeprest_(\w+) (\d+)$/);
+    if(m)counters.push(m[1]+": "+m[2]);
+  }
+  document.getElementById("counters").textContent=counters.join("  ·  ");
+  const rows=Object.keys(comps).sort().map(c=>"<tr><td>"+c+"</td>"+
+    RES.map(r=>"<td>"+(comps[c][r]===undefined?"—":comps[c][r].toFixed(1))+"</td>").join("")+"</tr>");
+  document.getElementById("rows").innerHTML=rows.join("");
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+)html";
+
+}  // namespace
+
+void Collector::MetricsLoop(const std::atomic<bool>& running) {
+  int listen_fd;
+  try {
+    listen_fd = ListenOn(options_.metrics_port);
+  } catch (const std::exception& e) {
+    // Observability is optional: a taken port must degrade (no /metrics),
+    // never take down the collector — the run's telemetry is the product.
+    SNS_LOG(LogLevel::Warning,
+            std::string("collector /metrics disabled: ") + e.what());
+    return;
+  }
+  SNS_LOG(LogLevel::Info, "collector /metrics on :" +
+                              std::to_string(options_.metrics_port));
+  while (running) {
+    int fd = AcceptWithTimeout(listen_fd, 200);
+    if (fd < 0) continue;
+    // One request per connection (a scrape), served inline; the recv/send
+    // timeout bounds how long a stalled client can hold the loop.
+    HttpConnection conn(fd);
+    conn.SetRecvTimeout(2000);
+    HttpRequest req;
+    if (!conn.ReadRequest(&req)) continue;
+    int status = 200;
+    const char* content_type = "text/plain; version=0.0.4";
+    std::string body;
+    if (req.path == "/metrics") {
+      body = MetricsText();
+    } else if (req.path == "/healthz") {
+      body = "ok\n";
+    } else if (req.path == "/" || req.path == "/dashboard") {
+      content_type = "text/html";
+      body = kDashboardHtml;
+    } else {
+      status = 404;
+      body = "not found\n";
+    }
+    conn.WriteResponse(status, body, /*keep_alive=*/false, content_type);
+  }
+  ::close(listen_fd);
+}
+
 void Collector::Run(const std::atomic<bool>& running) {
   std::thread ingest([this, &running] { IngestLoop(running); });
+  std::thread metrics;
+  if (options_.metrics_port > 0)
+    metrics = std::thread([this, &running] { MetricsLoop(running); });
   std::ofstream out(options_.output_path, std::ios::app);
   if (!out) throw std::runtime_error("cannot open " + options_.output_path);
 
@@ -325,6 +448,7 @@ void Collector::Run(const std::atomic<bool>& running) {
   out << bucket.dump() << "\n";
   out.flush();
   ingest.join();
+  if (metrics.joinable()) metrics.join();
 }
 
 }  // namespace sns

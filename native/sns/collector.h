@@ -14,6 +14,7 @@ namespace sns {
 
 struct CollectorOptions {
   int port = 0;
+  int metrics_port = 0;    // Prometheus /metrics + dashboard (0 = disabled)
   int interval_ms = 5000;  // scrape window = ML time-step (SURVEY.md §5.5)
   int grace_ms = 1000;     // quiet time before a trace is considered complete
   std::string output_path = "raw_data.jsonl";
@@ -39,9 +40,14 @@ class Collector {
   void RegisterProcess(const std::string& component, int pid);
   void Ingest(const Json& frame);      // span batch or registration frame
   Json CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns);
+  // Prometheus text-exposition snapshot of the live state (gauges from the
+  // latest cut bucket + ETL counters) — the reference's scrape surface
+  // (monitor-openebs-pg.yaml:38-173) for this process-cluster.
+  std::string MetricsText();
 
  private:
   void IngestLoop(const std::atomic<bool>& running);
+  void MetricsLoop(const std::atomic<bool>& running);
 
   ClusterConfig* config_;
   CollectorOptions options_;
@@ -49,6 +55,12 @@ class Collector {
   std::map<std::string, int> watched_;  // component -> pid
   std::unordered_map<uint64_t, PendingTrace> pending_;
   std::map<std::string, ProcSample> last_samples_;
+  // live observability state (all guarded by mu_)
+  std::map<std::pair<std::string, std::string>, double> latest_;
+  uint64_t spans_ingested_ = 0;
+  uint64_t traces_assembled_ = 0;
+  uint64_t traces_dropped_rootless_ = 0;
+  uint64_t buckets_written_ = 0;
 };
 
 }  // namespace sns

@@ -1,5 +1,7 @@
 #include "gateway.h"
 
+#include "http.h"
+
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -20,183 +22,6 @@ Json Obj(std::initializer_list<std::pair<const std::string, Json>> kv) {
   for (auto& [k, v] : kv) o[k] = v;
   return Json(std::move(o));
 }
-
-// ---------------------------------------------------------------------------
-// Minimal HTTP/1.1 plumbing (keep-alive, Content-Length bodies)
-
-struct HttpRequest {
-  std::string method;
-  std::string path;          // without query string
-  std::map<std::string, std::string> params;  // query + urlencoded form
-  std::string body;
-  bool keep_alive = true;
-};
-
-int HexVal(char c) {
-  if (c >= '0' && c <= '9') return c - '0';
-  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
-  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
-  return -1;
-}
-
-std::string UrlDecode(const std::string& s) {
-  std::string out;
-  out.reserve(s.size());
-  for (size_t i = 0; i < s.size(); ++i) {
-    if (s[i] == '+') {
-      out.push_back(' ');
-    } else if (s[i] == '%' && i + 2 < s.size()) {
-      int hi = HexVal(s[i + 1]), lo = HexVal(s[i + 2]);
-      if (hi >= 0 && lo >= 0) {
-        out.push_back(static_cast<char>(hi * 16 + lo));
-        i += 2;
-      } else {
-        out.push_back('%');
-      }
-    } else {
-      out.push_back(s[i]);
-    }
-  }
-  return out;
-}
-
-void ParseParams(const std::string& s, std::map<std::string, std::string>* out) {
-  size_t pos = 0;
-  while (pos < s.size()) {
-    size_t amp = s.find('&', pos);
-    if (amp == std::string::npos) amp = s.size();
-    size_t eq = s.find('=', pos);
-    if (eq != std::string::npos && eq < amp)
-      (*out)[UrlDecode(s.substr(pos, eq - pos))] =
-          UrlDecode(s.substr(eq + 1, amp - eq - 1));
-    pos = amp + 1;
-  }
-}
-
-class HttpConnection {
- public:
-  explicit HttpConnection(int fd) : fd_(fd) {}
-  ~HttpConnection() { ::close(fd_); }
-
-  bool ReadRequest(HttpRequest* req) {
-    std::string head;
-    if (!ReadUntil("\r\n\r\n", &head)) return false;
-    std::istringstream hs(head);
-    std::string line;
-    if (!std::getline(hs, line)) return false;
-    if (!line.empty() && line.back() == '\r') line.pop_back();
-    std::istringstream rl(line);
-    std::string version;
-    rl >> req->method >> req->path >> version;
-    if (req->method.empty() || req->path.empty()) return false;
-    req->keep_alive = version != "HTTP/1.0";
-
-    size_t content_length = 0;
-    std::string content_type;
-    while (std::getline(hs, line)) {
-      if (!line.empty() && line.back() == '\r') line.pop_back();
-      if (line.empty()) break;
-      size_t colon = line.find(':');
-      if (colon == std::string::npos) continue;
-      std::string key = line.substr(0, colon);
-      for (auto& c : key) c = static_cast<char>(tolower(c));
-      std::string value = line.substr(colon + 1);
-      while (!value.empty() && value.front() == ' ') value.erase(value.begin());
-      if (key == "content-length") {
-        // No exceptions here: a malformed header must fail the connection,
-        // not escape the handler thread and terminate the process.
-        char* end = nullptr;
-        unsigned long long n = strtoull(value.c_str(), &end, 10);
-        if (end == value.c_str() || *end != '\0') return false;
-        content_length = static_cast<size_t>(n);
-      } else if (key == "content-type") content_type = value;
-      else if (key == "connection" && value == "close") req->keep_alive = false;
-    }
-
-    size_t q = req->path.find('?');
-    if (q != std::string::npos) {
-      ParseParams(req->path.substr(q + 1), &req->params);
-      req->path.resize(q);
-    }
-    if (content_length > 0) {
-      if (content_length > (64u << 20)) return false;
-      if (!ReadBody(content_length, &req->body)) return false;
-      if (content_type.find("application/x-www-form-urlencoded") !=
-          std::string::npos)
-        ParseParams(req->body, &req->params);
-    }
-    return true;
-  }
-
-  bool WriteResponse(int status, const std::string& body, bool keep_alive,
-                     const char* content_type = "application/json") {
-    static const std::map<int, const char*> kReasons = {
-        {200, "OK"}, {400, "Bad Request"}, {404, "Not Found"},
-        {500, "Internal Server Error"}};
-    auto it = kReasons.find(status);
-    std::ostringstream out;
-    out << "HTTP/1.1 " << status << " "
-        << (it == kReasons.end() ? "Unknown" : it->second) << "\r\n"
-        << "Content-Type: " << content_type << "\r\n"
-        << "Content-Length: " << body.size() << "\r\n"
-        << "Connection: " << (keep_alive ? "keep-alive" : "close") << "\r\n\r\n"
-        << body;
-    std::string data = out.str();
-    return WriteAll(data.data(), data.size());
-  }
-
- private:
-  bool ReadUntil(const char* delim, std::string* out) {
-    size_t dlen = strlen(delim);
-    while (true) {
-      size_t hit = buffer_.find(delim);
-      if (hit != std::string::npos) {
-        *out = buffer_.substr(0, hit + dlen);
-        buffer_.erase(0, hit + dlen);
-        return true;
-      }
-      if (buffer_.size() > (1u << 20)) return false;
-      char chunk[4096];
-      ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
-      if (r <= 0) {
-        if (r < 0 && errno == EINTR) continue;
-        return false;
-      }
-      buffer_.append(chunk, static_cast<size_t>(r));
-    }
-  }
-
-  bool ReadBody(size_t n, std::string* out) {
-    while (buffer_.size() < n) {
-      char chunk[8192];
-      ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
-      if (r <= 0) {
-        if (r < 0 && errno == EINTR) continue;
-        return false;
-      }
-      buffer_.append(chunk, static_cast<size_t>(r));
-    }
-    *out = buffer_.substr(0, n);
-    buffer_.erase(0, n);
-    return true;
-  }
-
-  bool WriteAll(const char* data, size_t n) {
-    while (n > 0) {
-      ssize_t w = ::send(fd_, data, n, MSG_NOSIGNAL);
-      if (w <= 0) {
-        if (w < 0 && errno == EINTR) continue;
-        return false;
-      }
-      data += w;
-      n -= static_cast<size_t>(w);
-    }
-    return true;
-  }
-
-  int fd_;
-  std::string buffer_;
-};
 
 // ---------------------------------------------------------------------------
 // Route handlers

@@ -1,0 +1,46 @@
+// Shared server-side HTTP/1.1 machinery — one stack for the gateways
+// (nginx-thrift / media-frontend roles) and the collector's /metrics
+// endpoint, so parsing hardening lives in exactly one place.
+#pragma once
+
+#include <map>
+#include <string>
+
+namespace sns {
+
+struct HttpRequest {
+  std::string method;
+  std::string path;          // without query string
+  std::map<std::string, std::string> params;  // query + urlencoded form
+  std::string body;
+  bool keep_alive = true;
+};
+
+std::string UrlDecode(const std::string& s);
+void ParseParams(const std::string& s, std::map<std::string, std::string>* out);
+
+class HttpConnection {
+ public:
+  explicit HttpConnection(int fd) : fd_(fd) {}
+  ~HttpConnection();
+  HttpConnection(const HttpConnection&) = delete;
+  HttpConnection& operator=(const HttpConnection&) = delete;
+
+  // Bound recv() so a silent client cannot wedge a single-threaded server
+  // (the collector's scrape endpoint serves connections inline).
+  void SetRecvTimeout(int ms);
+
+  bool ReadRequest(HttpRequest* req);
+  bool WriteResponse(int status, const std::string& body, bool keep_alive,
+                     const char* content_type = "application/json");
+
+ private:
+  bool ReadUntil(const char* delim, std::string* out);
+  bool ReadBody(size_t n, std::string* out);
+  bool WriteAll(const char* data, size_t n);
+
+  int fd_;
+  std::string buffer_;
+};
+
+}  // namespace sns

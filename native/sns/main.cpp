@@ -144,6 +144,7 @@ int RunRole(const std::string& component, ClusterConfig& cfg, int argc,
   } else if (component == "trace-collector") {
     CollectorOptions opts;
     opts.port = self.port;
+    opts.metrics_port = std::stoi(ArgValue(argc, argv, "metrics-port", "0"));
     opts.interval_ms = std::stoi(ArgValue(argc, argv, "interval-ms", "5000"));
     opts.grace_ms = std::stoi(ArgValue(argc, argv, "grace-ms", "1000"));
     opts.output_path = ArgValue(argc, argv, "out", "raw_data.jsonl");

