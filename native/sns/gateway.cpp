@@ -1,6 +1,7 @@
 #include "gateway.h"
 
 #include "http.h"
+#include "pages.h"
 
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -165,6 +166,18 @@ void RunGateway(const std::string& role, int port, ClusterConfig* cfg,
         if (!conn.WriteResponse(200, "ok", req.keep_alive, "text/plain")) break;
         req = HttpRequest();
         continue;
+      }
+      // Static browsable pages (nginx-thrift role only) — the reference's
+      // nginx-web-server/pages/; untraced, like nginx static file serving.
+      if (!is_media) {
+        auto page = StaticPages().find(req.path);
+        if (page != StaticPages().end()) {
+          if (!conn.WriteResponse(200, page->second, req.keep_alive,
+                                  "text/html"))
+            break;
+          req = HttpRequest();
+          continue;
+        }
       }
       int status = 200;
       std::string body;

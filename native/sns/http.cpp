@@ -63,6 +63,24 @@ void HttpConnection::SetRecvTimeout(int ms) {
   tv.tv_usec = (ms % 1000) * 1000;
   ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
   ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  // The per-recv timeout alone does not stop a slow-drip client (one byte
+  // per just-under-timeout keeps every recv succeeding); bound the total
+  // request read with the same budget.
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  deadline_ns_ = static_cast<unsigned long long>(ts.tv_sec) * 1000000000ull +
+                 static_cast<unsigned long long>(ts.tv_nsec) +
+                 static_cast<unsigned long long>(ms) * 1000000ull;
+}
+
+bool HttpConnection::DeadlineExpired() const {
+  if (deadline_ns_ == 0) return false;
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  unsigned long long now =
+      static_cast<unsigned long long>(ts.tv_sec) * 1000000000ull +
+      static_cast<unsigned long long>(ts.tv_nsec);
+  return now >= deadline_ns_;
 }
 
 bool HttpConnection::ReadRequest(HttpRequest* req) {
@@ -143,6 +161,7 @@ bool HttpConnection::ReadUntil(const char* delim, std::string* out) {
       return true;
     }
     if (buffer_.size() > (1u << 20)) return false;
+    if (DeadlineExpired()) return false;
     char chunk[4096];
     ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
     if (r <= 0) {
@@ -155,6 +174,7 @@ bool HttpConnection::ReadUntil(const char* delim, std::string* out) {
 
 bool HttpConnection::ReadBody(size_t n, std::string* out) {
   while (buffer_.size() < n) {
+    if (DeadlineExpired()) return false;
     char chunk[8192];
     ssize_t r = ::recv(fd_, chunk, sizeof chunk, 0);
     if (r <= 0) {

@@ -26,8 +26,9 @@ class HttpConnection {
   HttpConnection(const HttpConnection&) = delete;
   HttpConnection& operator=(const HttpConnection&) = delete;
 
-  // Bound recv() so a silent client cannot wedge a single-threaded server
-  // (the collector's scrape endpoint serves connections inline).
+  // Bound the WHOLE request read so neither a silent client (recv timeout)
+  // nor a slow-drip one (total deadline) can wedge a single-threaded
+  // server (the collector's scrape endpoint serves connections inline).
   void SetRecvTimeout(int ms);
 
   bool ReadRequest(HttpRequest* req);
@@ -38,9 +39,12 @@ class HttpConnection {
   bool ReadUntil(const char* delim, std::string* out);
   bool ReadBody(size_t n, std::string* out);
   bool WriteAll(const char* data, size_t n);
+  bool DeadlineExpired() const;
 
   int fd_;
   std::string buffer_;
+  // monotonic ns deadline for the whole request read; 0 = unbounded
+  unsigned long long deadline_ns_ = 0;
 };
 
 }  // namespace sns

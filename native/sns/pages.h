@@ -1,0 +1,95 @@
+// Human-browsable static pages served by the nginx-thrift gateway role —
+// the counterpart of the reference's nginx-web-server/pages/ (signup /
+// main / profile / contact HTML+JS calling the same API the load
+// generator drives). Embedded in the binary: the process-cluster has no
+// config PVC to mount page files from (reference mounts them,
+// nginx-thrift.yaml:70-80).
+#pragma once
+
+#include <map>
+#include <string>
+
+namespace sns {
+
+// path -> html. Shared shell + per-page body, assembled at first use.
+inline const std::map<std::string, std::string>& StaticPages() {
+  static const std::map<std::string, std::string>* pages = [] {
+    const std::string style = R"PAGE(<style>
+body{font-family:system-ui,sans-serif;max-width:640px;margin:2em auto;padding:0 1em;background:#fafafa}
+nav a{margin-right:1em}input,textarea{display:block;margin:.4em 0;padding:.4em;width:100%;box-sizing:border-box}
+button{padding:.5em 1.2em;margin:.4em 0}pre{background:#fff;border:1px solid #ddd;padding:.8em;overflow:auto}
+.post{background:#fff;border:1px solid #eee;padding:.6em .9em;margin:.5em 0;border-radius:4px}
+</style>)PAGE";
+    const std::string nav =
+        "<nav><a href=\"/\">home</a><a href=\"/signup.html\">sign up</a>"
+        "<a href=\"/profile.html\">profile</a>"
+        "<a href=\"/contact.html\">contact</a></nav>";
+    const std::string js = R"PAGE(<script>
+async function api(path, params){
+  const body = new URLSearchParams(params).toString();
+  const resp = await fetch(path, {method:"POST",
+    headers:{"Content-Type":"application/x-www-form-urlencoded"}, body});
+  const text = await resp.text();
+  if(!resp.ok) throw new Error(text);
+  try { return JSON.parse(text); } catch(e){ return text; }
+}
+function renderPosts(el, posts){
+  el.innerHTML = (posts||[]).map(p =>
+    `<div class="post"><b>user ${p.creator_id??""}</b> ${p.text??""}</div>`
+  ).join("") || "<i>no posts</i>";
+}
+</script>)PAGE";
+    auto page = [&](const std::string& title, const std::string& body) {
+      return "<!doctype html><html><head><meta charset=\"utf-8\"><title>" +
+             title + "</title>" + style + "</head><body>" + nav + "<h2>" +
+             title + "</h2>" + body + js + "</body></html>";
+    };
+
+    auto* m = new std::map<std::string, std::string>();
+    (*m)["/main.html"] = page("home timeline", R"PAGE(
+<form onsubmit="event.preventDefault();
+  api('/wrk2-api/post/compose', {user_id:uid.value, username:uname.value,
+      text:text.value}).then(()=>load()).catch(e=>alert(e))">
+<input id="uid" placeholder="user id"><input id="uname" placeholder="username">
+<textarea id="text" placeholder="what's happening?"></textarea>
+<button>post</button></form>
+<button onclick="load()">refresh</button><div id="posts"></div>
+<script>async function load(){
+  const r = await api('/wrk2-api/home-timeline/read', {user_id:uid.value||0});
+  renderPosts(document.getElementById('posts'), r.posts||r);
+}</script>)PAGE");
+    (*m)["/signup.html"] = page("sign up", R"PAGE(
+<form onsubmit="event.preventDefault();
+  api('/wrk2-api/user/register', {user_id:uid.value, username:uname.value,
+      password:pw.value}).then(()=>out.textContent='registered')
+      .catch(e=>out.textContent=e)">
+<input id="uid" placeholder="user id"><input id="uname" placeholder="username">
+<input id="pw" type="password" placeholder="password">
+<button>register</button></form>
+<h3>follow</h3>
+<form onsubmit="event.preventDefault();
+  api('/wrk2-api/user/follow', {user_id:fuid.value, followee_id:fid.value})
+      .then(()=>out.textContent='followed').catch(e=>out.textContent=e)">
+<input id="fuid" placeholder="your user id">
+<input id="fid" placeholder="user id to follow"><button>follow</button></form>
+<pre id="out"></pre>)PAGE");
+    (*m)["/profile.html"] = page("user timeline", R"PAGE(
+<form onsubmit="event.preventDefault(); load()">
+<input id="uid" placeholder="user id"><button>load timeline</button></form>
+<div id="posts"></div>
+<script>async function load(){
+  const r = await api('/wrk2-api/user-timeline/read', {user_id:uid.value||0});
+  renderPosts(document.getElementById('posts'), r.posts||r);
+}</script>)PAGE");
+    (*m)["/contact.html"] = page("contact", R"PAGE(
+<p>This plane is the TPU-native rebuild's application-under-observation:
+a social network whose traces and resource telemetry feed the
+resource-estimation model. See the repository README for the pipeline.</p>)PAGE");
+    (*m)["/"] = (*m)["/main.html"];
+    (*m)["/index.html"] = (*m)["/main.html"];
+    return m;
+  }();
+  return *pages;
+}
+
+}  // namespace sns

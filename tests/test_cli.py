@@ -147,3 +147,18 @@ def test_featurize_out_without_extension(tmp_path):
     # save appended .npz and load resolves the bare name too
     data = FeaturizedData.load(str(tmp_path / "feats"))
     assert data.traffic.shape[0] == 5
+
+
+def test_train_profile_capture(pipeline, tmp_path):
+    """--profile-dir captures a jax.profiler trace of the first epoch
+    (SURVEY.md §5.1: the ML-plane profiling the reference lacks)."""
+    import glob
+
+    profile_dir = str(tmp_path / "profile")
+    assert main(["train", f"--features={pipeline['feats']}", "--epochs=1",
+                 "--batch-size=16", "--window=20", "--hidden-size=8",
+                 "--no-baselines", f"--profile-dir={profile_dir}"]) == 0
+    planes = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                       recursive=True)
+    assert planes, f"no xplane artifact under {profile_dir}"
+    assert os.path.getsize(planes[0]) > 0

@@ -270,3 +270,44 @@ def test_collector_metrics_endpoint_live(tmp_path):
             f"http://{host}:{port}/healthz", timeout=10).read().decode()
         assert ok.strip() == "ok"
         c.close()
+
+
+@needs_snsd
+def test_gateway_serves_browsable_pages(tmp_path):
+    """The human-browsable static pages (reference: nginx-web-server/pages/)
+    must load from the gateway, and the API they call must work with the
+    form-urlencoded bodies their JS sends."""
+    import urllib.parse
+    import urllib.request
+
+    out = str(tmp_path / "pages_raw.jsonl")
+    with SnsCluster(out_path=out, interval_ms=800) as cluster:
+        host, port = cluster.gateway_addr
+        base = f"http://{host}:{port}"
+        for path in ("/", "/signup.html", "/main.html", "/profile.html",
+                     "/contact.html"):
+            html = urllib.request.urlopen(base + path, timeout=10).read().decode()
+            assert "<html" in html, path
+            assert "wrk2-api" in html or path == "/contact.html", path
+        # the page JS posts application/x-www-form-urlencoded
+        def form_post(path, **params):
+            data = urllib.parse.urlencode(params).encode()
+            req = urllib.request.Request(
+                base + path, data=data,
+                headers={"Content-Type": "application/x-www-form-urlencoded"})
+            return urllib.request.urlopen(req, timeout=10).read().decode()
+
+        form_post("/wrk2-api/user/register", user_id=701,
+                  username="user701", password="pw")
+        form_post("/wrk2-api/post/compose", user_id=701,
+                  username="user701", text="posted from the browser page")
+        timeline = form_post("/wrk2-api/user-timeline/read", user_id=701)
+        assert "posted from the browser page" in timeline
+        # media frontend does NOT serve the pages (reference split:
+        # pages live on nginx-thrift only)
+        mh, mp = cluster.media_addr
+        try:
+            urllib.request.urlopen(f"http://{mh}:{mp}/signup.html", timeout=10)
+            assert False, "media-frontend should not serve pages"
+        except urllib.error.HTTPError as e:
+            assert e.code in (404, 500)
