@@ -143,7 +143,7 @@ def cmd_train(args) -> int:
         jax.profiler.start_trace(args.profile_dir)
         profiling = True
 
-    def on_epoch(result, state):
+    def stop_profiling():
         nonlocal profiling
         if profiling:
             import jax
@@ -151,6 +151,9 @@ def cmd_train(args) -> int:
             jax.profiler.stop_trace()
             profiling = False
             print(f"profiler trace written to {args.profile_dir}", flush=True)
+
+    def on_epoch(result, state):
+        stop_profiling()    # first epoch captured: compile + steady steps
         line = (f"epoch {result.epoch}: train {result.train_loss:.4f}"
                 + (f" test {result.test_loss:.4f}" if result.test_loss else ""))
         print(line, flush=True)
@@ -161,15 +164,10 @@ def cmd_train(args) -> int:
         state, history = trainer.fit(bundle, baseline_preds=baselines,
                                      on_epoch=on_epoch)
     finally:
-        if profiling:
-            # fit() raised (or ran zero epochs) before on_epoch could stop
-            # the trace — flush it anyway: the failing run is exactly the
-            # one worth profiling.
-            import jax
-
-            jax.profiler.stop_trace()
-            profiling = False
-            print(f"profiler trace written to {args.profile_dir}", flush=True)
+        # fit() may raise (or run zero epochs) before on_epoch could stop
+        # the trace — flush it anyway: the failing run is exactly the one
+        # worth profiling.
+        stop_profiling()
     print(format_report(history[-1].report))
     print(f"steady-state throughput: {trainer.throughput.steps_per_sec:.2f} steps/s")
 

@@ -197,6 +197,96 @@ def deployment(name: str, image: str, replicas: int = 1,
     }
 
 
+def monitoring_stack() -> list[dict]:
+    """A deployable Prometheus scraping the annotated pods — the L0
+    monitoring tier the reference configures by hand (reference:
+    minikube-openebs/monitor-openebs-pg.yaml:38-173: 5s base scrape over
+    explicit jobs; here one annotation-driven kubernetes_sd job). The
+    Grafana role is played by the collector's built-in /dashboard."""
+    prom_config = {
+        "global": {"scrape_interval": "5s"},   # ML time-step contract
+        "scrape_configs": [{
+            "job_name": "deeprest-pods",
+            "kubernetes_sd_configs": [{
+                "role": "pod",
+                "namespaces": {"names": [NAMESPACE]},
+            }],
+            "relabel_configs": [
+                {"source_labels":
+                     ["__meta_kubernetes_pod_annotation_prometheus_io_scrape"],
+                 "action": "keep", "regex": "true"},
+                {"source_labels":
+                     ["__meta_kubernetes_pod_annotation_prometheus_io_path"],
+                 "action": "replace", "target_label": "__metrics_path__",
+                 "regex": "(.+)"},
+                {"source_labels":
+                     ["__address__",
+                      "__meta_kubernetes_pod_annotation_prometheus_io_port"],
+                 "action": "replace", "target_label": "__address__",
+                 "regex": r"([^:]+)(?::\d+)?;(\d+)",
+                 "replacement": "$1:$2"},
+                {"source_labels": ["__meta_kubernetes_pod_label_app"],
+                 "action": "replace", "target_label": "app"},
+            ],
+        }],
+    }
+    sa = {"apiVersion": "v1", "kind": "ServiceAccount",
+          "metadata": _meta("prometheus")}
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+        "metadata": _meta("prometheus"),
+        "rules": [{"apiGroups": [""], "resources": ["pods"],
+                   "verbs": ["get", "list", "watch"]}],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+        "metadata": _meta("prometheus"),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "Role",
+                    "name": "prometheus"},
+        "subjects": [{"kind": "ServiceAccount", "name": "prometheus",
+                      "namespace": NAMESPACE}],
+    }
+    config = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta("prometheus-config"),
+        "data": {"prometheus.yml": json.dumps(prom_config, indent=2)},
+    }
+    dep = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("prometheus"),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "prometheus"}},
+            "template": {
+                "metadata": {"labels": {"app": "prometheus",
+                                        "plane": "deeprest-sns"}},
+                "spec": {
+                    "serviceAccountName": "prometheus",
+                    "containers": [{
+                        "name": "prometheus",
+                        "image": "prom/prometheus:v2.53.0",
+                        "args": ["--config.file=/etc/prometheus/prometheus.yml",
+                                 "--storage.tsdb.retention.time=2d"],
+                        "ports": [{"containerPort": 9090}],
+                        "volumeMounts": [{"name": "config",
+                                          "mountPath": "/etc/prometheus"}],
+                    }],
+                    "volumes": [{"name": "config",
+                                 "configMap": {"name": "prometheus-config"}}],
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta("prometheus"),
+        "spec": {"selector": {"app": "prometheus"},
+                 "ports": [{"name": "http", "port": 9090,
+                            "targetPort": 9090}]},
+    }
+    return [sa, role, binding, config, dep, svc]
+
+
 def loadgen_job(image: str) -> dict:
     """Drives the DEPLOYED plane through its gateway services (the locust
     role, reference: locust/README.md:23-33); the deployed collector owns
@@ -249,6 +339,7 @@ def generate(image: str) -> dict[str, list[dict]]:
                    metrics_port=METRICS_PORT),
     ]
     files["loadgen-job.yaml"] = [loadgen_job(image)]
+    files["monitoring.yaml"] = monitoring_stack()
     return files
 
 

@@ -64,13 +64,10 @@ void HttpConnection::SetRecvTimeout(int ms) {
   ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
   ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
   // The per-recv timeout alone does not stop a slow-drip client (one byte
-  // per just-under-timeout keeps every recv succeeding); bound the total
-  // request read with the same budget.
-  timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  deadline_ns_ = static_cast<unsigned long long>(ts.tv_sec) * 1000000000ull +
-                 static_cast<unsigned long long>(ts.tv_nsec) +
-                 static_cast<unsigned long long>(ms) * 1000000ull;
+  // per just-under-timeout keeps every recv succeeding); bound each whole
+  // request read with the same budget, re-armed per request so keep-alive
+  // connections are not penalized for their age.
+  budget_ms_ = ms;
 }
 
 bool HttpConnection::DeadlineExpired() const {
@@ -84,6 +81,14 @@ bool HttpConnection::DeadlineExpired() const {
 }
 
 bool HttpConnection::ReadRequest(HttpRequest* req) {
+  if (budget_ms_ > 0) {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    deadline_ns_ =
+        static_cast<unsigned long long>(ts.tv_sec) * 1000000000ull +
+        static_cast<unsigned long long>(ts.tv_nsec) +
+        static_cast<unsigned long long>(budget_ms_) * 1000000ull;
+  }
   std::string head;
   if (!ReadUntil("\r\n\r\n", &head)) return false;
   std::istringstream hs(head);

@@ -43,7 +43,9 @@ class HttpConnection {
 
   int fd_;
   std::string buffer_;
-  // monotonic ns deadline for the whole request read; 0 = unbounded
+  // per-request read budget (ms; 0 = unbounded) and the current request's
+  // monotonic ns deadline, re-armed at the top of every ReadRequest
+  int budget_ms_ = 0;
   unsigned long long deadline_ns_ = 0;
 };
 

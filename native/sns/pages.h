@@ -33,9 +33,11 @@ async function api(path, params){
   if(!resp.ok) throw new Error(text);
   try { return JSON.parse(text); } catch(e){ return text; }
 }
+function esc(v){const d=document.createElement("div");
+  d.textContent=String(v??"");return d.innerHTML;}
 function renderPosts(el, posts){
   el.innerHTML = (posts||[]).map(p =>
-    `<div class="post"><b>user ${p.creator_id??""}</b> ${p.text??""}</div>`
+    `<div class="post"><b>user ${esc(p.creator_id)}</b> ${esc(p.text)}</div>`
   ).join("") || "<i>no posts</i>";
 }
 </script>)PAGE";

@@ -4,6 +4,7 @@ labels encode the dataflow graph, and the committed deploy/k8s/ output is
 in sync with the generator."""
 
 import glob
+import json
 import os
 import subprocess
 import sys
@@ -134,3 +135,26 @@ def test_collector_prometheus_scrape_annotations():
     assert {"containerPort": port, "name": "metrics"} in container["ports"]
     assert any(p.get("name") == "metrics" and p["port"] == port
                for p in svc["spec"]["ports"])
+
+
+def test_monitoring_stack_scrapes_annotated_pods():
+    """The deployable Prometheus (reference: monitor-openebs-pg.yaml) must
+    keep only annotation-opted pods, honor the port/path annotations, and
+    use the 5s scrape interval (the ML time-step contract, SURVEY.md §5.5)."""
+    docs = FILES["monitoring.yaml"]
+    kinds = {d["kind"] for d in docs}
+    assert {"ServiceAccount", "Role", "RoleBinding", "ConfigMap",
+            "Deployment", "Service"} <= kinds
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    prom = json.loads(cm["data"]["prometheus.yml"])
+    assert prom["global"]["scrape_interval"] == "5s"
+    job = prom["scrape_configs"][0]
+    assert job["kubernetes_sd_configs"][0]["namespaces"]["names"] == [
+        generate.NAMESPACE]
+    relabels = job["relabel_configs"]
+    keep = next(r for r in relabels if r.get("action") == "keep")
+    assert "prometheus_io_scrape" in keep["source_labels"][0]
+    # RBAC is namespace-scoped pod read-only
+    role = next(d for d in docs if d["kind"] == "Role")
+    assert role["rules"][0]["resources"] == ["pods"]
+    assert set(role["rules"][0]["verbs"]) == {"get", "list", "watch"}
