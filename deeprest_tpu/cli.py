@@ -258,7 +258,8 @@ def cmd_stream(args) -> int:
                      finetune_epochs=args.finetune_epochs,
                      history_max=args.history_max,
                      eval_holdout=args.eval_holdout,
-                     poll_interval_s=args.poll_interval),
+                     poll_interval_s=args.poll_interval,
+                     keep_checkpoints=args.keep_checkpoints),
         ckpt_dir=args.ckpt_dir,
         feature_config=FeaturizeConfig(hash_features=True,
                                        capacity=args.capacity,
@@ -514,6 +515,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fine-tune after this many new buckets")
     p.add_argument("--finetune-epochs", type=int, default=2)
     p.add_argument("--history-max", type=int, default=4096)
+    def positive_int(v: str) -> int:
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"{v} must be >= 1")
+        return n
+
+    p.add_argument("--keep-checkpoints", type=positive_int, default=3,
+                   help="newest checkpoint steps retained (disk bound, "
+                        ">= 1)")
     p.add_argument("--eval-holdout", type=int, default=8,
                    help="newest windows held out for eval each refresh")
     p.add_argument("--poll-interval", type=float, default=0.5)

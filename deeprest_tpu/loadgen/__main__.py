@@ -71,6 +71,10 @@ def main(argv: list[str] | None = None) -> int:
                          "proves nothing — e.g. kubectl port-forward)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.burn_local and args.collector is None:
+        ap.error("--burn-local requires --collector: without a collector "
+                 "registration the burner's CPU is attributed to nothing "
+                 "and the crypto anomaly never reaches the corpus")
 
     scenario = SCENARIOS[args.scenario](args.seed)
     graph = synthetic_social_graph(args.users, seed=args.seed)

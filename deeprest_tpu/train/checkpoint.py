@@ -83,6 +83,21 @@ def load_sidecar(directory: str, step: int | None = None,
         raise
 
 
+def prune_checkpoints(directory: str, keep: int) -> list[int]:
+    """Delete all but the newest ``keep`` checkpoint steps; returns the
+    pruned step numbers. A forever-process (streaming retrain) would
+    otherwise grow the checkpoint dir without bound."""
+    import shutil
+
+    if keep < 1:
+        raise ValueError(f"keep={keep} must be >= 1")
+    pruned = []
+    for step in list_steps(directory)[:-keep]:
+        shutil.rmtree(_step_dir(directory, step), ignore_errors=True)
+        pruned.append(step)
+    return pruned
+
+
 def restore_checkpoint(directory: str, target: Any,
                        step: int | None = None) -> tuple[Any, dict | None]:
     """Restore the train state (sharded like ``target``) and the sidecar.

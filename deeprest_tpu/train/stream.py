@@ -157,6 +157,7 @@ class StreamConfig:
     history_max: int = 4096          # retained buckets (memory bound)
     eval_holdout: int = 8            # newest windows held out per refresh
     poll_interval_s: float = 0.5
+    keep_checkpoints: int = 3        # newest steps retained (disk bound)
 
 
 @dataclasses.dataclass
@@ -321,6 +322,9 @@ class StreamingTrainer:
                     "stream_refresh_count": self._refresh_count,
                     "stream_x_union": self.x_union.to_dict(),
                 })
+            from deeprest_tpu.train.checkpoint import prune_checkpoints
+
+            prune_checkpoints(self.ckpt_dir, self.stream.keep_checkpoints)
         return RefreshResult(
             refresh=self._refresh_count, num_buckets=self.num_buckets,
             train_loss=train_loss, eval_loss=float(eval_loss),

@@ -466,3 +466,25 @@ def test_stream_live_cluster_end_to_end(tmp_path):
             stop.set()
             t.join(timeout=10)
         cluster.stop(drain_s=0.5)
+
+
+def test_checkpoint_retention_bounds_disk(tmp_path):
+    """A forever-streaming process must not grow the checkpoint dir without
+    bound: only the newest keep_checkpoints steps survive, and resume still
+    works from the newest."""
+    from deeprest_tpu.train.checkpoint import list_steps
+
+    ckpt = str(tmp_path / "ckpt")
+    st = StreamingTrainer(
+        trainer_config(), stream_config(keep_checkpoints=2),
+        ckpt_dir=ckpt,
+        feature_config=FeaturizeConfig(hash_features=True, capacity=CAPACITY))
+    buckets = make_series_buckets(120, seed=1)
+    for i in range(4):
+        for b in buckets[i * 30:(i + 1) * 30]:
+            st.ingest(b)
+        st.refresh()
+    steps = list_steps(ckpt)
+    assert len(steps) == 2                   # pruned to the retention bound
+    st2 = make_trainer(ckpt_dir=ckpt)        # newest survivor resumes
+    assert st2._refresh_count == 4
