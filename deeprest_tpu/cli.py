@@ -109,9 +109,30 @@ def cmd_featurize(args) -> int:
 
 
 def cmd_train(args) -> int:
-    from deeprest_tpu.config import Config, ModelConfig, TrainConfig
+    from deeprest_tpu.config import Config, MeshConfig, ModelConfig, TrainConfig
     from deeprest_tpu.models.baselines import baseline_predictions
+    from deeprest_tpu.parallel import initialize_distributed
     from deeprest_tpu.train import Trainer, format_report, prepare_dataset
+
+    # Multi-host: join the job when one is configured (env/pod metadata);
+    # after this jax.devices() is the global set and --mesh lays the
+    # (data, expert, model) axes over it. No-op on a single host.
+    if initialize_distributed():
+        import jax
+
+        print(f"distributed: process {jax.process_index()} of "
+              f"{jax.process_count()}, {len(jax.devices())} global devices",
+              flush=True)
+
+    mesh_cfg = MeshConfig()
+    if args.mesh:
+        try:
+            d, e, m = (int(x) for x in args.mesh.split(","))
+        except ValueError:
+            sys.exit(f"error: --mesh {args.mesh!r} is not data,expert,model")
+        if min(d, e, m) < 1:
+            sys.exit(f"error: --mesh {args.mesh!r}: axis sizes must be >= 1")
+        mesh_cfg = MeshConfig(data=d, expert=e, model=m)
 
     _require_input(args)
     data = _load_features(args)
@@ -124,6 +145,7 @@ def cmd_train(args) -> int:
                           train_split=args.split, seed=args.seed,
                           eval_stride=args.window,
                           checkpoint_dir=args.ckpt_dir or ""),
+        mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
     baselines = None
@@ -473,6 +495,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=0.5)
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--mesh", default=None, metavar="D,E,M",
+                   help="device mesh data,expert,model (default 1,1,1; "
+                        "multi-host joins via JAX_COORDINATOR_ADDRESS / "
+                        "pod metadata first)")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--plots-dir", default=None)
     p.add_argument("--profile-dir", default=None,

@@ -1,4 +1,4 @@
-"""Device-mesh construction and GSPMD sharding rules."""
+"""Device-mesh construction, GSPMD sharding rules, and multi-host bring-up."""
 
 from deeprest_tpu.parallel.mesh import make_mesh
 from deeprest_tpu.parallel.sharding import (
@@ -8,6 +8,12 @@ from deeprest_tpu.parallel.sharding import (
     shard_batch,
     shard_params,
 )
+from deeprest_tpu.parallel.distributed import (
+    feed_global_batch,
+    global_mesh,
+    initialize_distributed,
+    process_batch_slice,
+)
 
 __all__ = [
     "make_mesh",
@@ -16,4 +22,8 @@ __all__ = [
     "param_specs",
     "shard_batch",
     "shard_params",
+    "feed_global_batch",
+    "global_mesh",
+    "initialize_distributed",
+    "process_batch_slice",
 ]

@@ -1,0 +1,146 @@
+"""Multi-host bring-up: ``jax.distributed`` over DCN, one global mesh.
+
+The reference's only distribution is app-plane RPC (Thrift/AMQP/redis —
+SURVEY.md §5.8); its ML core is strictly single-device.  The multi-host
+tier here follows the TPU-native recipe instead of translating an
+NCCL/MPI design:
+
+- every host runs the SAME single-controller program and calls
+  :func:`initialize_distributed` first — a no-op for single-process runs,
+  so one code path serves laptop, single chip, and pod;
+- after initialization ``jax.devices()`` is the GLOBAL device set; the
+  (data, expert, model) mesh is laid over it with **data outermost** so
+  the per-step gradient all-reduce crosses DCN once while expert/model
+  collectives (the mixing sum, TP reductions) stay on intra-slice ICI
+  (the "collectives ride ICI, not DCN" rule);
+- each host feeds only its own shard of the global batch
+  (:func:`process_batch_slice` + :func:`feed_global_batch`), the standard
+  single-controller data path (``jax.make_array_from_process_local_data``).
+
+Single-process tests exercise all of this on the virtual CPU mesh; the
+arithmetic (slicing, axis layout) is process-count-parameterized so the
+multi-host math is testable without multiple hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeprest_tpu.config import MeshConfig
+from deeprest_tpu.parallel.mesh import AXES, make_mesh
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> bool:
+    """Join the multi-host job if one is configured; returns whether it was.
+
+    Configuration comes from the arguments or the standard environment
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``;
+    on TPU pods ``jax.distributed.initialize()`` auto-discovers all three
+    from the metadata server, so bare ``initialize_distributed()`` works
+    there too).  With no configuration at all this is a no-op returning
+    False — single-process runs never pay for the distributed service.
+    """
+    env = os.environ
+    coordinator_address = (coordinator_address
+                           or env.get("JAX_COORDINATOR_ADDRESS") or None)
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def global_mesh(config: MeshConfig | None = None,
+                devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """The (data, expert, model) mesh over the global device set.
+
+    A documentation-carrying alias of :func:`make_mesh` (same defaults):
+    after :func:`initialize_distributed`, ``jax.devices()`` is global, and
+    the C-order reshape puts the **data axis outermost** — it strides
+    across whole hosts, so the gradient all-reduce crosses DCN while
+    expert/model collectives stay on intra-host ICI.  The default config
+    (data = every device) is the DP north-star layout.
+    """
+    return make_mesh(config, devices=devices)
+
+
+def process_batch_slice(global_batch: int,
+                        process_index: int | None = None,
+                        process_count: int | None = None) -> slice:
+    """This process's contiguous slice of the global batch axis.
+
+    The global batch must divide evenly — a ragged split would desync the
+    compiled step's static shapes across hosts.
+    """
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    if global_batch % process_count != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{process_count} processes")
+    per = global_batch // process_count
+    return slice(process_index * per, (process_index + 1) * per)
+
+
+def feed_global_batch(mesh: Mesh, global_batch: np.ndarray,
+                      axes: tuple[str | None, ...] | None = None) -> jax.Array:
+    """Turn the host-side GLOBAL batch into the global data-sharded array.
+
+    Every process passes the same ``global_batch`` view (deterministic
+    selection keeps them identical across hosts); each keeps only its
+    :func:`process_batch_slice` and ``make_array_from_process_local_data``
+    stitches the global array — no host ever ships another host's rows to
+    its devices.  Under one process this is just a sharded device_put, so
+    the trainer uses one feed path everywhere.
+    """
+    if axes is None:
+        axes = ("data",) + (None,) * (global_batch.ndim - 1)
+    sharding = NamedSharding(mesh, P(*axes))
+    if jax.process_count() == 1:
+        return jax.device_put(global_batch, sharding)
+    local = global_batch[process_batch_slice(len(global_batch))]
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local))
+
+
+def feed_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """A fully-replicated global array from identical per-process data
+    (eval/predict inputs: every process holds the same windows)."""
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+
+
+def gather_to_host(arr: jax.Array) -> np.ndarray:
+    """A numpy copy of a possibly cross-host-sharded array on every host
+    (eval predictions feeding the host-side MAE report)."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+__all__ = [
+    "AXES",
+    "initialize_distributed",
+    "global_mesh",
+    "process_batch_slice",
+    "feed_global_batch",
+    "feed_replicated",
+    "gather_to_host",
+]

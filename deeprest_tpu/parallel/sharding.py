@@ -70,8 +70,24 @@ def batch_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
 
 
 def shard_params(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, jax.Array]:
+    """Place (replicated-identical) host params onto the mesh.
+
+    On one host a sharded device_put; on a pod each process materializes
+    only its addressable shards (``make_array_from_callback``) — init
+    with the same PRNGKey makes every host's source params identical.
+    """
+    import numpy as np
+
     shardings = param_sharding(mesh, params)
-    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+    def put(v, shd):
+        if jax.process_count() == 1:
+            return jax.device_put(v, shd)
+        host = np.asarray(v)
+        return jax.make_array_from_callback(host.shape, shd,
+                                            lambda idx: host[idx])
+
+    return {k: put(v, shardings[k]) for k, v in params.items()}
 
 
 def shard_batch(mesh: Mesh, *arrays: jax.Array | Any) -> tuple[jax.Array, ...]:

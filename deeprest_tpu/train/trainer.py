@@ -28,8 +28,11 @@ import optax
 from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU
 from deeprest_tpu.ops.quantile import pinball_loss
+from deeprest_tpu.parallel.distributed import (
+    feed_global_batch, feed_replicated, gather_to_host,
+)
 from deeprest_tpu.parallel.mesh import make_mesh
-from deeprest_tpu.parallel.sharding import batch_sharding, shard_params
+from deeprest_tpu.parallel.sharding import shard_params
 from deeprest_tpu.train.data import DatasetBundle, eval_window_indices
 from deeprest_tpu.train.metrics import Throughput, mae_report
 
@@ -63,7 +66,6 @@ class Trainer:
         self.model = QuantileGRU(config=self.model_config)
         self.tx = optax.adam(config.train.learning_rate)
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
-        self._batch_shd = batch_sharding(self.mesh)
         self.throughput = Throughput()
         self._warmed = False       # first-ever step (jit compile) excluded
         self._global_step = 0      # host-side mirror of state.step for logging
@@ -143,9 +145,12 @@ class Trainer:
         if measuring:
             self.throughput.start()
         for sel, weight in self._batches(len(bundle.x_train), epoch_rng):
-            xb = jax.device_put(bundle.x_train[sel], self._batch_shd)
-            yb = jax.device_put(bundle.y_train[sel], self._batch_shd)
-            wb = jax.device_put(weight, batch_sharding(self.mesh, 1))
+            # feed_global_batch: sharded device_put on one host; on a pod,
+            # each process ships only its process_batch_slice of the
+            # (identical, rng-deterministic) global selection.
+            xb = feed_global_batch(self.mesh, bundle.x_train[sel])
+            yb = feed_global_batch(self.mesh, bundle.y_train[sel])
+            wb = feed_global_batch(self.mesh, weight)
             state, loss = self._train_step(state, xb, yb, wb)
             losses.append(loss)
             self._global_step += 1
@@ -184,9 +189,12 @@ class Trainer:
                                   cfg.eval_max_cycles)
         if len(idx) == 0:
             raise ValueError("no eval windows: test split shorter than stride")
-        xb = jnp.asarray(bundle.x_test[idx])
-        yb = jnp.asarray(bundle.y_test[idx])
+        # Replicated feed: the (≤ eval_max_cycles) eval windows need not
+        # divide the data axis, and every process holds the same windows.
+        xb = feed_replicated(self.mesh, bundle.x_test[idx])
+        yb = feed_replicated(self.mesh, bundle.y_test[idx])
         preds, loss = self._eval_step(state.params, xb, yb)
+        preds = gather_to_host(preds)
 
         # Floor the *normalized* median prediction at 1e-6 before
         # de-normalizing — the reference's clamp order (estimate.py:100-103);
@@ -269,6 +277,6 @@ class Trainer:
         """Normalized quantile predictions ``[N, W, E, Q]`` for windows x."""
         outs = []
         for lo in range(0, len(x), batch_size):
-            xb = jnp.asarray(x[lo:lo + batch_size])
-            outs.append(np.asarray(self._predict_step(state.params, xb)))
+            xb = feed_replicated(self.mesh, x[lo:lo + batch_size])
+            outs.append(gather_to_host(self._predict_step(state.params, xb)))
         return np.concatenate(outs, axis=0)

@@ -1,0 +1,84 @@
+"""Worker script for the REAL multi-process training test (spawned by
+tests/test_multihost.py, one subprocess per simulated host).
+
+Each process owns 4 virtual CPU devices and joins a 2-process
+jax.distributed job → 8 global devices; a (data=4, expert=2, model=1)
+mesh spans both "hosts". The full Trainer path runs: deterministic
+synthetic bundle (identical on both processes), one epoch of sharded
+training with per-process batch feeding, then a replicated eval. The
+final losses are printed for the parent to compare across processes and
+against the single-process run.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeprest_tpu.config import Config, MeshConfig, ModelConfig, TrainConfig
+from deeprest_tpu.data.windows import MinMaxStats
+from deeprest_tpu.parallel import global_mesh, initialize_distributed
+from deeprest_tpu.train import Trainer
+from deeprest_tpu.train.data import DatasetBundle
+
+
+def make_bundle(batch, window, feature_dim, num_metrics):
+    rng = np.random.default_rng(0)        # identical on every process
+    names = [f"c{i}_cpu" for i in range(num_metrics)]
+    return DatasetBundle(
+        x_train=rng.random((2 * batch, window, feature_dim)).astype(np.float32),
+        y_train=rng.random((2 * batch, window, num_metrics)).astype(np.float32),
+        x_test=rng.random((window, window, feature_dim)).astype(np.float32),
+        y_test=rng.random((window, window, num_metrics)).astype(np.float32),
+        x_stats=MinMaxStats(min=np.float32(0), max=np.float32(1)),
+        y_stats=MinMaxStats(min=np.zeros((1, num_metrics), np.float32),
+                            max=np.ones((1, num_metrics), np.float32)),
+        metric_names=names, split=2 * batch, window_size=window)
+
+
+def main() -> int:
+    coordinator = sys.argv[1]
+    process_id = int(sys.argv[2])
+    single = len(sys.argv) > 3 and sys.argv[3] == "--single"
+
+    if not single:
+        joined = initialize_distributed(coordinator_address=coordinator,
+                                        num_processes=2,
+                                        process_id=process_id)
+        assert joined, "distributed init did not run"
+        assert jax.process_count() == 2, jax.process_count()
+        assert len(jax.devices()) == 8, len(jax.devices())
+
+    batch, window, feature_dim, num_metrics = 8, 6, 16, 4
+    mesh = global_mesh(MeshConfig(data=4, expert=2, model=1)
+                       if not single else MeshConfig(data=2, expert=2))
+    bundle = make_bundle(batch, window, feature_dim, num_metrics)
+    cfg = Config(
+        model=ModelConfig(feature_dim=feature_dim, num_metrics=num_metrics,
+                          hidden_size=8, dropout_rate=0.0,
+                          rnn_backend="scan"),
+        train=TrainConfig(batch_size=batch, window_size=window,
+                          eval_stride=window, eval_max_cycles=1,
+                          log_every_steps=0, seed=0),
+    )
+    trainer = Trainer(cfg, feature_dim, bundle.metric_names, mesh=mesh)
+    state = trainer.init_state(bundle.x_train)
+    state, train_loss = trainer.train_epoch(state, bundle,
+                                            np.random.default_rng(1))
+    eval_loss, _ = trainer.evaluate(state, bundle)
+    print(f"RESULT process={process_id} train={train_loss:.8f} "
+          f"eval={eval_loss:.8f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,3 +162,21 @@ def test_train_profile_capture(pipeline, tmp_path):
                        recursive=True)
     assert planes, f"no xplane artifact under {profile_dir}"
     assert os.path.getsize(planes[0]) > 0
+
+
+def test_train_mesh_flag_runs_sharded(pipeline, tmp_path):
+    """--mesh lays the full (data, expert, model) mesh under the train CLI
+    (8 virtual CPU devices via conftest)."""
+    ckpt = str(tmp_path / "ckpt_mesh")
+    assert main(["train", f"--features={pipeline['feats']}", "--epochs=1",
+                 "--batch-size=16", "--window=20", "--hidden-size=8",
+                 "--no-baselines", "--mesh", "2,2,2",
+                 f"--ckpt-dir={ckpt}"]) == 0
+    assert any(n.startswith("step_") for n in os.listdir(ckpt))
+
+
+def test_train_mesh_flag_rejects_garbage(pipeline):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["train", f"--features={pipeline['feats']}", "--mesh", "lots"])

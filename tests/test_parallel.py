@@ -176,3 +176,67 @@ def test_ten_k_endpoint_width_sharded_correctness():
     np.testing.assert_allclose(
         np.asarray(m_state.params["mask_w2"]),
         np.asarray(s_state.params["mask_w2"]), rtol=5e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host tier (single-process semantics; the per-process arithmetic is
+# parameterized so pod math is testable without a pod)
+
+def test_initialize_distributed_noop_without_config(monkeypatch):
+    from deeprest_tpu.parallel import initialize_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_distributed() is False   # single-process: no service
+
+
+def test_global_mesh_default_is_pure_dp():
+    from deeprest_tpu.parallel import global_mesh
+
+    mesh = global_mesh()
+    assert mesh.axis_names == ("data", "expert", "model")
+    assert mesh.devices.shape == (8, 1, 1)     # every device on data
+
+
+def test_global_mesh_data_axis_strides_across_hosts():
+    """C-order reshape puts data outermost: with 2 hosts x 4 local devices
+    and a (2, 2, 2) mesh, each data row must be one host's devices — the
+    gradient all-reduce crosses hosts, expert/model stay intra-host."""
+    from deeprest_tpu.parallel import global_mesh
+
+    devices = jax.devices()                    # simulate host0 = [0:4]
+    mesh = global_mesh(MeshConfig(data=2, expert=2, model=2))
+    row0 = {d.id for d in mesh.devices[0].flat}
+    row1 = {d.id for d in mesh.devices[1].flat}
+    assert row0 == {d.id for d in devices[:4]}
+    assert row1 == {d.id for d in devices[4:]}
+
+
+def test_process_batch_slice_partitions_exactly():
+    from deeprest_tpu.parallel import process_batch_slice
+
+    slices = [process_batch_slice(32, process_index=i, process_count=4)
+              for i in range(4)]
+    covered = []
+    for s in slices:
+        covered.extend(range(32)[s])
+    assert covered == list(range(32))          # disjoint, ordered, complete
+    with pytest.raises(ValueError, match="not divisible"):
+        process_batch_slice(30, process_index=0, process_count=4)
+    # single-process default: the whole batch
+    assert process_batch_slice(16) == slice(0, 16)
+
+
+def test_feed_global_batch_shards_on_data():
+    from deeprest_tpu.parallel import feed_global_batch, global_mesh
+
+    mesh = global_mesh(MeshConfig(data=8))
+    local = np.arange(16 * 3 * 2, dtype=np.float32).reshape(16, 3, 2)
+    arr = feed_global_batch(mesh, local)
+    assert arr.shape == (16, 3, 2)
+    assert arr.sharding.spec == P("data", None, None)
+    assert len(arr.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(arr), local)
+    # and it is directly consumable by the sharded trainer's step shape
+    assert arr.addressable_shards[0].data.shape == (2, 3, 2)
