@@ -319,15 +319,32 @@ def cmd_export(args) -> int:
 def cmd_serve(args) -> int:
     """Serve predict / what-if / anomaly over HTTP from a checkpoint or an
     exported artifact (serve/server.py)."""
-    from deeprest_tpu.serve.server import PredictionServer, PredictionService
+    from deeprest_tpu.serve.server import (
+        CheckpointReloader, PredictionServer, PredictionService,
+    )
 
     if bool(args.ckpt_dir) == bool(args.artifact):
         sys.exit("error: provide exactly one of --ckpt-dir or --artifact")
+    if args.watch and not args.ckpt_dir:
+        sys.exit("error: --watch requires --ckpt-dir (artifacts are "
+                 "immutable; re-export and restart instead)")
+    if args.watch < 0:
+        sys.exit(f"error: --watch {args.watch} must be >= 0")
+    reloader = None
     if args.ckpt_dir:
         from deeprest_tpu.serve.predictor import Predictor
 
+        if args.watch:
+            # Built BEFORE the initial load: a checkpoint the live trainer
+            # writes while we load would otherwise be recorded as already
+            # served and never reloaded. Worst case of this ordering is one
+            # redundant reload of the step we are about to serve anyway.
+            reloader = CheckpointReloader(args.ckpt_dir,
+                                          min_interval_s=args.watch)
         pred = Predictor.from_checkpoint(args.ckpt_dir)
         backend = f"checkpoint:{args.ckpt_dir}"
+        if reloader is not None:
+            backend += " (watching)"
     else:
         from deeprest_tpu.serve.export import ExportedPredictor
 
@@ -344,7 +361,8 @@ def cmd_serve(args) -> int:
                      "what-if synthesizer from --raw")
         synthesizer = TraceSynthesizer(space).fit(_load_buckets(args.raw))
 
-    service = PredictionService(pred, synthesizer, backend=backend)
+    service = PredictionService(pred, synthesizer, backend=backend,
+                                reloader=reloader)
     server = PredictionServer(service, host=args.host, port=args.port)
     host, port = server.address
     print(json.dumps({"listening": f"http://{host}:{port}",
@@ -571,6 +589,9 @@ def build_parser() -> argparse.ArgumentParser:
                             "anomaly")
     p.add_argument("--ckpt-dir", default=None,
                    help="serve the in-process predictor from this checkpoint")
+    p.add_argument("--watch", type=float, default=0, metavar="SECONDS",
+                   help="with --ckpt-dir: hot-reload newer checkpoints, "
+                        "polling at most every SECONDS (0 = off)")
     p.add_argument("--artifact", default=None,
                    help="serve the exported artifact from this directory")
     p.add_argument("--raw", default=None,

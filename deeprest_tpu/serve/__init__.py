@@ -6,7 +6,7 @@ from deeprest_tpu.serve.whatif import WhatIfEstimator
 from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
 from deeprest_tpu.serve.export import ExportedPredictor, export_predictor
 from deeprest_tpu.serve.server import (
-    PredictionServer, PredictionService, ServingError,
+    CheckpointReloader, PredictionServer, PredictionService, ServingError,
 )
 
 __all__ = [
@@ -17,6 +17,7 @@ __all__ = [
     "AnomalyReport",
     "ExportedPredictor",
     "export_predictor",
+    "CheckpointReloader",
     "PredictionServer",
     "PredictionService",
     "ServingError",

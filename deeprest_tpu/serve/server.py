@@ -42,6 +42,55 @@ class ServingError(ValueError):
         self.status = status
 
 
+class CheckpointReloader:
+    """Follow a checkpoint directory being written by a live trainer
+    (e.g. the streaming retrain loop): ``poll()`` returns a fresh
+    Predictor when a newer complete step has appeared, else None.
+
+    Assumes the architecture is fixed across steps (true for streaming —
+    the model config freezes at the first refresh), so a mid-request swap
+    only changes params/normalization stats, which are internally
+    consistent within each Predictor.
+    """
+
+    def __init__(self, ckpt_dir: str, min_interval_s: float = 2.0):
+        from deeprest_tpu.train.checkpoint import latest_step
+
+        self.ckpt_dir = ckpt_dir
+        self.min_interval_s = min_interval_s
+        self._last_step = latest_step(ckpt_dir)
+        self._next_check = 0.0
+        self._lock = threading.Lock()
+
+    def poll(self):
+        import time
+
+        from deeprest_tpu.serve.predictor import Predictor
+        from deeprest_tpu.train.checkpoint import latest_step
+
+        # Non-blocking: while one handler thread performs the (seconds-
+        # long) reload, concurrent requests keep serving the current model
+        # instead of queueing on the lock.
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            now = time.monotonic()
+            if now < self._next_check:
+                return None
+            self._next_check = now + self.min_interval_s
+            step = latest_step(self.ckpt_dir)
+            if step is None or step == self._last_step:
+                return None
+            try:
+                fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step)
+            except (FileNotFoundError, ValueError):
+                return None   # step mid-write or pruned; retry next poll
+            self._last_step = step
+            return fresh
+        finally:
+            self._lock.release()
+
+
 def _as_array(payload: dict, key: str, ndim: int) -> np.ndarray:
     if key not in payload:
         raise ServingError(f"missing field {key!r}")
@@ -56,13 +105,35 @@ def _as_array(payload: dict, key: str, ndim: int) -> np.ndarray:
 
 class PredictionService:
     """Route handlers over a serving backend (Predictor or
-    ExportedPredictor) — transport-free, so tests can call it directly."""
+    ExportedPredictor) — transport-free, so tests can call it directly.
 
-    def __init__(self, predictor, synthesizer=None, backend: str = ""):
+    ``reloader`` (optional) makes the service follow a live training
+    process: before each request it is asked for a fresh backend (or None
+    to keep the current one) — see :class:`CheckpointReloader`.
+    """
+
+    def __init__(self, predictor, synthesizer=None, backend: str = "",
+                 reloader=None):
         self.predictor = predictor
         self.backend = backend
+        self._synthesizer = synthesizer
+        self._reloader = reloader
+        self.reloads = 0
         self.whatif = (WhatIfEstimator(predictor, synthesizer)
                        if synthesizer is not None else None)
+
+    def maybe_reload(self) -> None:
+        """Swap in a newer backend if the reloader has one (serving a
+        continuously-retrained checkpoint dir must not go stale)."""
+        if self._reloader is None:
+            return
+        fresh = self._reloader.poll()
+        if fresh is None:
+            return
+        self.predictor = fresh
+        self.reloads += 1
+        if self._synthesizer is not None:
+            self.whatif = WhatIfEstimator(fresh, self._synthesizer)
 
     # -- GET ------------------------------------------------------------
 
@@ -72,6 +143,7 @@ class PredictionService:
             "backend": self.backend,
             "num_metrics": len(self.predictor.metric_names),
             "window_size": self.predictor.window_size,
+            "reloads": self.reloads,
         }
 
     def meta(self) -> dict:
@@ -221,6 +293,7 @@ class PredictionServer:
                 if name is None:
                     return self._reply(404, {"error": f"no route {self.path}"})
                 try:
+                    outer.service.maybe_reload()
                     self._reply(200, getattr(outer.service, name)())
                 except Exception as e:  # never drop the connection silently
                     self._reply(500, {"error": f"internal: {e}"})
@@ -230,6 +303,7 @@ class PredictionServer:
                 if name is None:
                     return self._reply(404, {"error": f"no route {self.path}"})
                 try:
+                    outer.service.maybe_reload()
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     if not isinstance(payload, dict):

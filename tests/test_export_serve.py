@@ -256,3 +256,58 @@ def test_cli_export_subcommand(world, tmp_path, capsys):
     np.testing.assert_allclose(
         exported.predict_series(traffic),
         world["pred"].predict_series(traffic), rtol=1e-5, atol=1e-5)
+
+
+def test_serving_hot_reloads_streaming_checkpoints(tmp_path):
+    """The continuous loop closes: a server watching a checkpoint dir must
+    swap in the streaming trainer's newer checkpoints between requests —
+    serving never goes stale while retraining runs."""
+    from conftest import make_series_buckets
+
+    from deeprest_tpu.config import Config, FeaturizeConfig, TrainConfig
+    from deeprest_tpu.serve import CheckpointReloader, Predictor
+    from deeprest_tpu.train.stream import StreamConfig, StreamingTrainer
+
+    ckpt = str(tmp_path / "ckpt")
+    cap = 32
+    st = StreamingTrainer(
+        Config(model=ModelConfig(feature_dim=cap, hidden_size=8),
+               train=TrainConfig(batch_size=8, window_size=6, seed=0,
+                                 eval_stride=1, eval_max_cycles=2,
+                                 log_every_steps=0)),
+        StreamConfig(refresh_buckets=12, finetune_epochs=1, history_max=256,
+                     eval_holdout=2),
+        ckpt_dir=ckpt,
+        feature_config=FeaturizeConfig(hash_features=True, capacity=cap))
+    buckets = make_series_buckets(80, seed=1)
+    for b in buckets[:40]:
+        st.ingest(b)
+    st.refresh()
+
+    service = PredictionService(
+        Predictor.from_checkpoint(ckpt), None, backend="watching",
+        reloader=CheckpointReloader(ckpt, min_interval_s=0.0))
+    srv = PredictionServer(service, port=0).start()
+    try:
+        client = Client(srv.address)
+        traffic = np.stack([st.space.extract(b.traces)
+                            for b in buckets[40:52]]).tolist()
+        status, before = client.request("POST", "/v1/predict",
+                                        {"traffic": traffic})
+        assert status == 200
+        _, h = client.request("GET", "/healthz")
+        assert h["reloads"] == 0
+
+        for b in buckets[40:]:
+            st.ingest(b)
+        st.refresh()                       # writes a newer checkpoint
+
+        status, after = client.request("POST", "/v1/predict",
+                                       {"traffic": traffic})
+        assert status == 200
+        _, h = client.request("GET", "/healthz")
+        assert h["reloads"] == 1           # hot-swapped mid-flight
+        assert not np.allclose(np.asarray(before["predictions"]),
+                               np.asarray(after["predictions"]))
+    finally:
+        srv.stop()
