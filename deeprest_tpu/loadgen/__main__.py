@@ -99,8 +99,23 @@ def main(argv: list[str] | None = None) -> int:
             burner = Burner(args.ticks * args.tick_seconds / 2,
                             collector_addr=collector_addr,
                             component=args.burn_component)
+
+            def start_burner():
+                # Timer threads swallow exceptions; a failed registration
+                # must be LOUD — the whole point of the crypto scenario is
+                # the injected anomaly, and a silent skip produces a clean
+                # corpus labeled anomalous.
+                try:
+                    burner.start()
+                except OSError as e:
+                    print(
+                        "ERROR: crypto burner registration failed "
+                        f"({e}); the run will contain NO cryptojack "
+                        "anomaly — discard this corpus for anomaly work.",
+                        file=sys.stderr)
+
             timer = threading.Timer(args.ticks * args.tick_seconds / 4,
-                                    burner.start)
+                                    start_burner)
             timer.start()
         try:
             return runner.run(args.ticks)

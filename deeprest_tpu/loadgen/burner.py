@@ -70,15 +70,30 @@ class Burner:
         self._proc: subprocess.Popen | None = None
 
     def start(self) -> "Burner":
-        cmd = [sys.executable, "-m", "deeprest_tpu.loadgen.burner",
+        # Run the module FILE, not `-m deeprest_tpu...`: the package import
+        # chain costs ~2s of child startup, during which a short burn window
+        # would produce zero attributed samples.  The file itself only needs
+        # the stdlib, so the child starts hashing almost immediately.
+        cmd = [sys.executable, os.path.abspath(__file__),
                f"--duration={self.duration_s}"]
-        if self.collector_addr and self.component:
-            host, port = self.collector_addr
-            cmd += [f"--collector={host}:{port}", f"--component={self.component}"]
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         )
+        if self.collector_addr and self.component:
+            # Register from the parent — the child pid is known the moment
+            # Popen returns, so attribution starts at t=0 instead of racing
+            # the child's interpreter startup.  If registration fails the
+            # burner must not keep running unattributed (it would burn CPU
+            # that no component's metrics can explain): kill it and re-raise.
+            from deeprest_tpu.loadgen.client import register_with_collector
+
+            host, port = self.collector_addr
+            try:
+                register_with_collector(host, port, self.component,
+                                        self._proc.pid)
+            except OSError:
+                self.stop()
+                raise
         return self
 
     def wait(self) -> None:
@@ -102,6 +117,15 @@ class Burner:
 
 
 def _main(argv: list[str]) -> int:
+    """Standalone entry point.
+
+    Two registration paths exist deliberately: :class:`Burner` registers
+    the child pid from the PARENT (no startup race, used by loadgen and
+    tests on a shared host), while the ``--collector``/``--component``
+    flags here support the reference's in-pod injection route — copying
+    this single stdlib-only file into a victim's pod and running it there,
+    where no parent exists (reference: locust/pow.py into a pod).
+    """
     duration, collector, component = 5.0, None, None
     for arg in argv:
         if arg.startswith("--duration="):
@@ -112,9 +136,16 @@ def _main(argv: list[str]) -> int:
         elif arg.startswith("--component="):
             component = arg.split("=", 1)[1]
     if collector and component:
-        from deeprest_tpu.loadgen.client import register_with_collector
+        # Inlined registration (same frame as loadgen.client.register_with_
+        # collector) so this file stays stdlib-only and runs copied into a
+        # pod with no deeprest_tpu package installed.
+        import json
+        import socket
 
-        register_with_collector(collector[0], collector[1], component, os.getpid())
+        payload = json.dumps({"register": component,
+                              "pid": os.getpid()}).encode()
+        with socket.create_connection(collector, timeout=2.0) as s:
+            s.sendall(struct.pack(">I", len(payload)) + payload)
     burn(duration)
     return 0
 

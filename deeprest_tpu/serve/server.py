@@ -60,35 +60,63 @@ class CheckpointReloader:
         self.min_interval_s = min_interval_s
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
+        self._pending = None       # loaded Predictor awaiting pickup
+        self._loading = False
         self._lock = threading.Lock()
 
     def poll(self):
         import time
 
-        from deeprest_tpu.serve.predictor import Predictor
         from deeprest_tpu.train.checkpoint import latest_step
 
-        # Non-blocking: while one handler thread performs the (seconds-
-        # long) reload, concurrent requests keep serving the current model
-        # instead of queueing on the lock.
-        if not self._lock.acquire(blocking=False):
-            return None
-        try:
+        # The seconds-long checkpoint load runs on a background thread —
+        # the request that notices a new step must not stall on it (a
+        # /healthz probe with a short timeout would flap on every refresh).
+        # poll() itself only does cheap bookkeeping: hand over a finished
+        # load, or kick one off.
+        with self._lock:
+            if self._pending is not None:
+                fresh, self._pending = self._pending, None
+                return fresh
+            if self._loading:
+                return None
             now = time.monotonic()
             if now < self._next_check:
                 return None
             self._next_check = now + self.min_interval_s
-            step = latest_step(self.ckpt_dir)
-            if step is None or step == self._last_step:
+        # The directory listing stays OUTSIDE the lock: on a slow filesystem
+        # (NFS/gcsfuse checkpoint dirs) a listing held under the lock would
+        # serialize every concurrent request behind it.
+        step = latest_step(self.ckpt_dir)
+        with self._lock:
+            if self._loading or step is None or step == self._last_step:
                 return None
-            try:
-                fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step)
-            except (FileNotFoundError, ValueError):
-                return None   # step mid-write or pruned; retry next poll
-            self._last_step = step
-            return fresh
+            self._loading = True
+        threading.Thread(target=self._load, args=(step,), daemon=True).start()
+        return None
+
+    def _load(self, step: int) -> None:
+        from deeprest_tpu.serve.predictor import Predictor
+
+        fresh = None
+        try:
+            fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step)
+        except Exception as e:
+            # Mid-write/pruned steps are expected (FileNotFoundError/
+            # ValueError); anything else is logged but must never wedge
+            # the reloader — _loading MUST be cleared or the server would
+            # silently never reload again.
+            if not isinstance(e, (FileNotFoundError, ValueError)):
+                import sys
+
+                print(f"checkpoint reload of step {step} failed: {e!r}",
+                      file=sys.stderr)
         finally:
-            self._lock.release()
+            with self._lock:
+                if fresh is not None:
+                    self._last_step = step
+                    self._pending = fresh
+                self._loading = False
 
 
 def _as_array(payload: dict, key: str, ndim: int) -> np.ndarray:

@@ -5,6 +5,7 @@ exports ... for the ... gRPC server"; SURVEY.md §7.1 step 6)."""
 
 import http.client
 import json
+import time
 
 import numpy as np
 import pytest
@@ -302,11 +303,19 @@ def test_serving_hot_reloads_streaming_checkpoints(tmp_path):
             st.ingest(b)
         st.refresh()                       # writes a newer checkpoint
 
+        # The reload is asynchronous (a request notices the new step and
+        # kicks off a background load; a later request picks it up), so
+        # requests stay fast — poll until the swap lands.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            _, h = client.request("GET", "/healthz")
+            if h["reloads"] == 1:
+                break
+            time.sleep(0.2)
+        assert h["reloads"] == 1           # hot-swapped, no restart
         status, after = client.request("POST", "/v1/predict",
                                        {"traffic": traffic})
         assert status == 200
-        _, h = client.request("GET", "/healthz")
-        assert h["reloads"] == 1           # hot-swapped mid-flight
         assert not np.allclose(np.asarray(before["predictions"]),
                                np.asarray(after["predictions"]))
     finally:
