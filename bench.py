@@ -90,9 +90,13 @@ CPU_TIMEOUT_S = 2400         # flagship f32 CPU steps are ~7s each
 # Measurement sizes.  The CPU fallback uses fewer steps and f32 (bf16 is
 # software-emulated on CPU, ~60s/step): it is a sanity anchor, not the
 # headline, and its JSON labels the dtype honestly.
-FULL = {"warmup": 5, "steps": 30, "trials": 3, "dtype": "bfloat16"}
+#
+# Step counts are sized so the end-of-trial host readback (the only sync
+# primitive that provably round-trips on the tunneled TPU backend — see
+# measure_main) is amortized to <2% of the trial.
+FULL = {"warmup": 5, "steps": 100, "trials": 3, "dtype": "bfloat16"}
 LIGHT = {"warmup": 1, "steps": 3, "trials": 1, "dtype": "float32"}
-TENK = {"warmup": 2, "steps": 10, "trials": 2, "dtype": "bfloat16"}
+TENK = {"warmup": 2, "steps": 20, "trials": 2, "dtype": "bfloat16"}
 
 TORCH_STEPS, TORCH_WARMUP = 10, 2
 
@@ -134,24 +138,48 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     w = np.ones((B,), np.float32)
 
     state = trainer.init_state(x)
-    for _ in range(sizes["warmup"]):
-        state, loss = trainer._train_step(state, x, y, w)
-    jax.block_until_ready(state.params)
 
-    # The chip is reached through a shared tunnel with visible run-to-run
-    # variance; take the best of a few trials as the steady-state figure.
+    # MEASUREMENT HONESTY (round-3 finding): on the tunneled TPU backend,
+    # `jax.block_until_ready` does NOT reliably synchronize with device
+    # execution — a timing loop "synced" that way measures dispatch rate
+    # (hundreds of fake steps/s).  The only primitive that provably
+    # round-trips is a host readback, so every trial ends with
+    # `float(loss)` and the steps-per-trial count amortizes that ~60ms
+    # round trip.  Inputs are staged on device ONCE: the headline is
+    # compute throughput with data resident in HBM (what an input
+    # pipeline sustains in steady state); the per-step host-feed cost is
+    # measured separately below and reported as `host_feed_steps_per_sec`.
+    import jax.numpy as jnp
+
+    x_d, y_d, w_d = jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
+    for _ in range(sizes["warmup"]):
+        state, loss = trainer._train_step(state, x_d, y_d, w_d)
+    if not np.isfinite(float(loss)):               # readback = real sync
+        raise RuntimeError(f"non-finite bench loss {loss}")
+
     best = 0.0
     for _ in range(sizes["trials"]):
         t0 = time.perf_counter()
         for _ in range(sizes["steps"]):
-            state, loss = trainer._train_step(state, x, y, w)
-        jax.block_until_ready(state.params)
+            state, loss = trainer._train_step(state, x_d, y_d, w_d)
+        lv = float(loss)                           # sync: host readback
         best = max(best, sizes["steps"] / (time.perf_counter() - t0))
-    if not np.isfinite(float(loss)):
-        raise RuntimeError(f"non-finite bench loss {loss}")
+    if not np.isfinite(lv):
+        raise RuntimeError(f"non-finite bench loss {lv}")
+
+    # End-to-end feed path: fresh numpy arrays shipped host->device every
+    # step (upper bound on input-pipeline cost; the tunnel makes this far
+    # more expensive than on a directly-attached chip).
+    host_steps = max(3, sizes["steps"] // 10)
+    t0 = time.perf_counter()
+    for _ in range(host_steps):
+        state, loss = trainer._train_step(state, x, y, w)
+    float(loss)
+    host_sps = host_steps / (time.perf_counter() - t0)
     dev = jax.devices()[0]
     out = {
         "steps_per_sec": best,
+        "host_feed_steps_per_sec": host_sps,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "dtype": sizes["dtype"],
@@ -308,6 +336,11 @@ def _mfu_block(measured: dict, features: int) -> dict:
     for k in ("model_state_bytes", "hbm_bytes_in_use", "hbm_peak_bytes"):
         if k in measured:
             block[k] = measured[k]
+    if "host_feed_steps_per_sec" in measured:
+        # Same step fed fresh numpy arrays each call: what the tunnel-
+        # attached host pipeline sustains without prefetch overlap.
+        block["host_feed_steps_per_sec"] = round(
+            float(measured["host_feed_steps_per_sec"]), 3)
     return block
 
 
@@ -337,6 +370,14 @@ def main() -> None:
             f"{TORCH_STEPS} steps, reference-equivalent model) — the "
             "reference publishes no throughput and no GPU exists on this "
             "host; use perf.mfu_pct as the absolute anchor"),
+        "measurement_note": (
+            "Round-3 fix: earlier rounds synced trials with "
+            "jax.block_until_ready, which does NOT wait for execution on "
+            "the tunneled TPU backend — those numbers (e.g. r02's 275.9 "
+            "steps/s) measured dispatch rate, not compute. Trials now end "
+            "with a host readback of the loss (provably synchronizing) "
+            "and inputs are staged in HBM once; the separately-reported "
+            "host_feed_steps_per_sec covers the host->device feed path."),
     }
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]

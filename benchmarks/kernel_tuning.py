@@ -1,0 +1,171 @@
+"""Pallas GRU kernel tuning experiments (diagnostic, TPU-only).
+
+Times forward-kernel variants at the flagship shape with honest readback
+sync, to pick the production configuration of ops/pallas_gru.py:
+
+- E_BLK sweep (experts per grid program): fewer grid programs = less
+  per-program pipeline overhead, more VMEM residency.
+- T_BLK (time steps per grid program): amortizes DMA/program overhead
+  across several sequential recurrence steps.
+- batched dot_general over the expert block vs a static Python unroll.
+- fused bidirectional: both directions stacked on the expert axis in ONE
+  kernel invocation (the backward direction's proj is pre-flipped), vs
+  two sequential kernel calls.
+
+Run: python benchmarks/kernel_tuning.py
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B, T, F, E, H = 32, 60, 512, 40, 128
+
+
+def make_fwd_call(e_blk_target: int, t_blk: int, batched_dot: bool,
+                  bf16_dot: bool = False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            h_scr[...] = h0_ref[...].astype(jnp.float32)
+
+        if batched_dot:
+            for tt in range(t_blk):
+                h = h_scr[...]                                # [EB, B, H]
+                w = w_ref[...].astype(jnp.float32)            # [EB, H, 3H]
+                gates_h = jax.lax.dot_general(
+                    h, w, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                ) + b_ref[...][:, None, :].astype(jnp.float32)
+                xproj = proj_ref[:, tt].astype(jnp.float32)   # [EB, B, 3H]
+                xr, xz, xn = jnp.split(xproj, 3, axis=-1)
+                hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h_new = (1.0 - z) * n + z * h
+                h_scr[...] = h_new
+                out_ref[:, tt] = h_new.astype(out_ref.dtype)
+        else:
+            # Time-OUTER, expert-INNER: at each time step the e_blk expert
+            # matmuls are independent and can pipeline through the MXU;
+            # expert-outer would serialize each expert's full t_blk chain.
+            n_e = proj_ref.shape[0]
+            dot_t = jnp.bfloat16 if bf16_dot else jnp.float32
+            hs = [h_scr[i] for i in range(n_e)]
+            ws = [w_ref[i].astype(dot_t) for i in range(n_e)]
+            bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
+            for tt in range(t_blk):
+                for i in range(n_e):
+                    gates_h = (
+                        jax.lax.dot_general(hs[i].astype(dot_t), ws[i],
+                                            (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+                        + bs[i]
+                    )
+                    xproj = proj_ref[i, tt].astype(jnp.float32)
+                    xr, xz, xn = jnp.split(xproj, 3, axis=-1)
+                    hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+                    r = jax.nn.sigmoid(xr + hr)
+                    z = jax.nn.sigmoid(xz + hz)
+                    n = jnp.tanh(xn + r * hn)
+                    hs[i] = (1.0 - z) * n + z * hs[i]
+                    out_ref[i, tt] = hs[i].astype(out_ref.dtype)
+            for i in range(n_e):
+                h_scr[i] = hs[i]
+
+    def call(proj, w_hh, b_hh, h0):
+        e, t, b, g3 = proj.shape
+        h = g3 // 3
+        assert t % t_blk == 0, (t, t_blk)
+        eb = e // e_blk_target if e % e_blk_target == 0 else 1
+        e_blk = e // eb
+        grid = (eb, t // t_blk)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((e_blk, t_blk, b, g3), lambda i, j: (i, j, 0, 0)),
+                pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
+                pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((e_blk, t_blk, b, h),
+                                   lambda i, j: (i, j, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((e, t, b, h), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary"),
+            ),
+        )(proj, w_hh, b_hh, h0)
+
+    return call
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.devices()[0].platform == "tpu", "TPU-only experiment"
+
+    rng = np.random.default_rng(0)
+    results = {}
+
+    def measure(fn, args, iters=50):
+        out = fn(*args)
+        _ = float(jnp.sum(out[..., 0]))   # compile + readback sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _ = float(jnp.sum(out[..., 0]))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    # ---- single-direction variants --------------------------------------
+    proj = jnp.asarray(rng.standard_normal((E, T, B, 3 * H)), jnp.float32)
+    w_hh = jnp.asarray(rng.standard_normal((E, H, 3 * H)) * 0.05, jnp.float32)
+    b_hh = jnp.asarray(rng.standard_normal((E, 3 * H)) * 0.05, jnp.float32)
+    h0 = jnp.zeros((E, B, H), jnp.float32)
+
+    # reference output for correctness
+    from deeprest_tpu.ops import pallas_gru
+    ref = pallas_gru.gru_recurrence(proj, w_hh, b_hh, h0, False)
+    ref_np = np.asarray(ref)
+
+    results["current_E8_T1_unroll"] = measure(
+        lambda p, w, b, h: pallas_gru.gru_recurrence(p, w, b, h, False),
+        (proj, w_hh, b_hh, h0))
+    print("current", results["current_E8_T1_unroll"], flush=True)
+
+    for e_blk, t_blk, bf16 in itertools.product((8,), (1, 2, 6, 12), (False, True)):
+        key = f"E{e_blk}_T{t_blk}_{'bf16' if bf16 else 'f32'}"
+        try:
+            call = jax.jit(make_fwd_call(e_blk, t_blk, False, bf16_dot=bf16))
+            ms = measure(call, (proj, w_hh, b_hh, h0))
+            err = float(np.max(np.abs(np.asarray(call(proj, w_hh, b_hh, h0))
+                                      - ref_np)))
+            results[key] = {"ms": round(ms, 3), "max_err": err}
+        except Exception as exc:
+            results[key] = {"error": str(exc)[:160]}
+        print(key, results[key], flush=True)
+
+    print(json.dumps(results, indent=2, default=str))
+
+
+if __name__ == "__main__":
+    main()
