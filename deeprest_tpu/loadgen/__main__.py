@@ -80,6 +80,8 @@ def main(argv: list[str] | None = None) -> int:
     graph = synthetic_social_graph(args.users, seed=args.seed)
     interval = args.interval_ms or int(args.tick_seconds * 1000)
 
+    burner_failure: list[str] = []
+
     def drive(gateway_addr, media_addr, collector_addr, with_burner=True):
         stats = warmup(*gateway_addr, graph)
         print(f"warmup: {stats}", file=sys.stderr)
@@ -104,10 +106,13 @@ def main(argv: list[str] | None = None) -> int:
                 # Timer threads swallow exceptions; a failed registration
                 # must be LOUD — the whole point of the crypto scenario is
                 # the injected anomaly, and a silent skip produces a clean
-                # corpus labeled anomalous.
+                # corpus labeled anomalous.  The failure is recorded so the
+                # run itself reports it (stats + nonzero exit), not just a
+                # stderr line nobody reads.
                 try:
                     burner.start()
                 except OSError as e:
+                    burner_failure.append(str(e))
                     print(
                         "ERROR: crypto burner registration failed "
                         f"({e}); the run will contain NO cryptojack "
@@ -148,9 +153,11 @@ def main(argv: list[str] | None = None) -> int:
         print(f"driving existing gateway {args.target}", file=sys.stderr)
         run_stats = drive(args.target, args.media, args.collector,
                           with_burner=with_burner)
+        if burner_failure:
+            run_stats["burner_failed"] = burner_failure[0]
         print(json.dumps({"scenario": args.scenario, "target": list(args.target),
                           **run_stats}))
-        return 0
+        return 1 if burner_failure else 0
 
     with SnsCluster(out_path=args.out, interval_ms=interval,
                     verbose=args.verbose) as cluster:
@@ -158,8 +165,10 @@ def main(argv: list[str] | None = None) -> int:
         run_stats = drive(cluster.gateway_addr, cluster.media_addr,
                           cluster.collector_addr)
         cluster.stop(drain_s=1.5)
+    if burner_failure:
+        run_stats["burner_failed"] = burner_failure[0]
     print(json.dumps({"scenario": args.scenario, "out": args.out, **run_stats}))
-    return 0
+    return 1 if burner_failure else 0
 
 
 if __name__ == "__main__":

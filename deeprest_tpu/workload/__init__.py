@@ -24,10 +24,16 @@ from deeprest_tpu.workload.scenarios import (
 )
 from deeprest_tpu.workload.telemetry import ResourceModel, Anomaly
 from deeprest_tpu.workload.simulator import simulate_corpus
+from deeprest_tpu.workload.microtopo import (
+    SyntheticMicroserviceApp,
+    TopologyParams,
+)
 
 __all__ = [
     "SocialNetworkApp",
     "API_ENDPOINTS",
+    "SyntheticMicroserviceApp",
+    "TopologyParams",
     "LoadScenario",
     "normal_scenario",
     "shape_scenario",

@@ -49,6 +49,12 @@ class LoadScenario:
     compositions: Sequence[tuple[float, float, float]] = SEEN_COMPOSITIONS
     calls_per_user: float = 2.0         # API calls per user per bucket
     seed: int = 0
+    # None → the 6 social-network endpoints with the reference's seen/unseen
+    # composition tables.  Set to N for a generic N-endpoint app (synthetic
+    # topologies): per-cycle compositions are then Dirichlet draws, which
+    # preserves the "API mix shifts every cycle" property without a
+    # hand-written table per app.
+    generic_endpoints: int | None = None
 
     def users_curve(self, num_buckets: int) -> np.ndarray:
         """Double-Gaussian two-peaks-per-cycle curve, fresh peaks each cycle
@@ -74,10 +80,16 @@ class LoadScenario:
         return users
 
     def composition_curve(self, num_buckets: int) -> np.ndarray:
-        """Per-cycle composition over the 6 endpoints → [T, 6] weights."""
+        """Per-cycle composition over the endpoints → [T, n_endpoints]."""
         rng = np.random.default_rng(self.seed + 1)
-        weights = np.empty((num_buckets, len(API_ENDPOINTS)))
         d = self.cycle_len
+        if self.generic_endpoints is not None:
+            n = self.generic_endpoints
+            weights = np.empty((num_buckets, n))
+            for c0 in range(0, num_buckets, d):
+                weights[c0:c0 + d] = rng.dirichlet(np.ones(n))
+            return weights[:num_buckets]
+        weights = np.empty((num_buckets, len(API_ENDPOINTS)))
         for c0 in range(0, num_buckets, d):
             compose, read_home, read_user = self.compositions[
                 int(rng.integers(0, len(self.compositions)))

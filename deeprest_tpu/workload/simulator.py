@@ -29,11 +29,30 @@ def simulate_corpus(
     app_params: AppParams | None = None,
     anomalies: list[Anomaly] | None = None,
     resource_seed: int | None = None,
+    app=None,
+    endpoints: tuple[str, ...] | None = None,
 ) -> list[Bucket]:
-    """Deterministic: same scenario/seeds → identical corpus."""
-    app = SocialNetworkApp(app_params)
+    """Deterministic: same scenario/seeds → identical corpus.
+
+    ``app``/``endpoints`` default to the social-network topology; pass any
+    object with ``generate(endpoint, rng) -> list[Span]`` (e.g.
+    :class:`microtopo.SyntheticMicroserviceApp`) plus its endpoint tuple to
+    simulate a different application.  The scenario's traffic matrix must be
+    as wide as ``endpoints`` (use ``LoadScenario.generic_endpoints``).
+    """
+    if app is None:
+        app = SocialNetworkApp(app_params)
+    if endpoints is None:
+        # Derive from the app when it declares its surface — defaulting a
+        # custom app to the social-network endpoint list could pass the
+        # width check by coincidence and fail deep in the bucket loop.
+        endpoints = tuple(getattr(app, "endpoints", API_ENDPOINTS))
     trace_rng = np.random.default_rng(scenario.seed + 3)
     traffic = scenario.traffic(num_buckets)          # [T, num_endpoints]
+    if traffic.shape[1] != len(endpoints):
+        raise ValueError(
+            f"scenario emits {traffic.shape[1]}-endpoint traffic but the app "
+            f"has {len(endpoints)} endpoints — set scenario.generic_endpoints")
 
     # Phase 1: generate traces, counting ops in the same walk (count_ops is
     # the only tree traversal; trees are not re-walked in phase 2).
@@ -42,7 +61,7 @@ def simulate_corpus(
     components: set[str] = set()
     for t in range(num_buckets):
         traces = []
-        for api_idx, api in enumerate(API_ENDPOINTS):
+        for api_idx, api in enumerate(endpoints):
             for _ in range(int(traffic[t, api_idx])):
                 traces.extend(app.generate(api, trace_rng))
         ops, writes = count_ops(traces)
@@ -87,11 +106,30 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--anomaly", type=parse_anomaly, action="append", default=[],
                     help="kind:component:start:end[:magnitude], repeatable")
     ap.add_argument("--calls-per-user", type=float, default=2.0)
+    ap.add_argument("--app", choices=("social", "synthetic"), default="social",
+                    help="application topology: the 12-service social network "
+                         "or a seeded synthetic DAG (TrainTicket scale)")
+    ap.add_argument("--services", type=int, default=40,
+                    help="synthetic app: number of services")
+    ap.add_argument("--endpoints", type=int, default=12,
+                    help="synthetic app: number of API endpoints")
     args = ap.parse_args(argv)
 
     scenario = SCENARIOS[args.scenario](args.seed)
     scenario.calls_per_user = args.calls_per_user
-    buckets = simulate_corpus(scenario, args.buckets, anomalies=args.anomaly)
+    app = endpoints = None
+    if args.app == "synthetic":
+        from deeprest_tpu.workload.microtopo import (
+            SyntheticMicroserviceApp, TopologyParams,
+        )
+
+        app = SyntheticMicroserviceApp(TopologyParams(
+            num_services=args.services, num_endpoints=args.endpoints,
+            seed=args.seed))
+        endpoints = app.endpoints
+        scenario.generic_endpoints = len(endpoints)
+    buckets = simulate_corpus(scenario, args.buckets, anomalies=args.anomaly,
+                              app=app, endpoints=endpoints)
     if args.out.endswith(".pkl"):
         save_raw_data_pickle(buckets, args.out)
     else:

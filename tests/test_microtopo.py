@@ -1,0 +1,119 @@
+"""Synthetic TrainTicket-scale topology (BASELINE.json configs[2]).
+
+The defining property of the config is topology *scale* — 40+ services,
+deep call chains, hundreds of component×resource metrics — flowing through
+the unchanged featurize → train → synthesize contract."""
+
+import numpy as np
+import pytest
+
+from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
+from deeprest_tpu.data.featurize import CallPathSpace, featurize_buckets
+from deeprest_tpu.data.synthesize import TraceSynthesizer
+from deeprest_tpu.workload import (
+    LoadScenario,
+    SyntheticMicroserviceApp,
+    TopologyParams,
+    simulate_corpus,
+)
+from deeprest_tpu.workload.telemetry import is_stateful
+
+
+def _app(seed=0, **kw):
+    return SyntheticMicroserviceApp(TopologyParams(seed=seed, **kw))
+
+
+def _scenario(app, seed=0, **kw):
+    kw.setdefault("base_users", 20.0)
+    kw.setdefault("peak_range", (25.0, 35.0))
+    kw.setdefault("cycle_len", 20)
+    return LoadScenario(name="synthetic", seed=seed,
+                        generic_endpoints=len(app.endpoints), **kw)
+
+
+def test_topology_deterministic_across_instances():
+    a, b = _app(seed=7), _app(seed=7)
+    rng_a, rng_b = np.random.default_rng(1), np.random.default_rng(1)
+    for ep in a.endpoints:
+        ta = [s.to_dict() for s in a.generate(ep, rng_a)]
+        tb = [s.to_dict() for s in b.generate(ep, rng_b)]
+        assert ta == tb
+    assert a.components == b.components
+    # different seed → different graph
+    assert _app(seed=8).components != a.components
+
+
+def test_topology_scale():
+    app = _app(num_services=44, num_endpoints=12)
+    comps = app.components
+    services = [c for c in comps if c.startswith("svc-")
+                and not is_stateful(c)]
+    stores = [c for c in comps if is_stateful(c)]
+    assert len(services) == 44
+    assert len(stores) >= 10          # store_fraction≈0.45 of 44 (+ caches)
+    assert len(app.endpoints) == 12
+
+
+def test_corpus_has_write_metrics_and_deep_paths():
+    app = _app(num_services=40)
+    buckets = simulate_corpus(_scenario(app), 30, app=app,
+                              endpoints=app.endpoints)
+    assert len(buckets) == 30
+    # stateful tier produces write metrics somewhere in the corpus
+    wiops = [m.value for b in buckets for m in b.metrics
+             if m.resource == "write-iops"]
+    assert len(wiops) > 0 and max(wiops) > 0
+    # call paths reach through the service layers (root + >=3 levels)
+    space = CallPathSpace.fit(buckets)
+    assert space.num_observed > 100    # far beyond the 6-endpoint app
+    assert max(len(p) for p in space.vocabulary()) >= 4
+
+
+def test_train_at_trainticket_scale():
+    """Featurize→train→eval with 200+ metric experts, loss finite and
+    improving — the expert axis at an order of magnitude beyond the
+    social-network app."""
+    from deeprest_tpu.train import Trainer, prepare_dataset
+
+    app = _app(num_services=40)
+    buckets = simulate_corpus(_scenario(app), 60, app=app,
+                              endpoints=app.endpoints)
+    cap = 256
+    cfg = Config(
+        model=ModelConfig(feature_dim=cap, hidden_size=8),
+        train=TrainConfig(batch_size=8, window_size=6, num_epochs=2,
+                          eval_stride=6, eval_max_cycles=2,
+                          log_every_steps=0, seed=0),
+    )
+    data = featurize_buckets(
+        buckets, FeaturizeConfig(hash_features=True, capacity=cap))
+    bundle = prepare_dataset(data, cfg.train)
+    n_metrics = len(bundle.metric_names)
+    assert n_metrics >= 200            # 40+ services × 5 resources + stores
+    trainer = Trainer(cfg, cap, bundle.metric_names)
+    state, history = trainer.fit(bundle)
+    losses = [h.train_loss for h in history]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert np.isfinite(history[-1].test_loss)
+
+
+def test_synthesizer_learns_per_endpoint_distributions():
+    app = _app(num_services=40)
+    buckets = simulate_corpus(_scenario(app), 30, app=app,
+                              endpoints=app.endpoints)
+    space = CallPathSpace.fit(buckets)
+    syn = TraceSynthesizer(space).fit(buckets)
+    eps = syn.endpoints                # root labels, e.g. "gateway-0_/api/ep00"
+    assert len(eps) >= 6
+    vec = syn.synthesize({eps[0]: 10, eps[1]: 5},
+                         rng=np.random.default_rng(0))
+    assert vec.shape == (space.capacity,)
+    assert vec.sum() > 0
+
+
+def test_scenario_width_mismatch_is_loud():
+    app = _app()
+    bad = LoadScenario(name="bad", seed=0)    # social 6-endpoint traffic
+    with pytest.raises(ValueError, match="generic_endpoints"):
+        simulate_corpus(bad, 5, app=app, endpoints=app.endpoints)
