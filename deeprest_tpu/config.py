@@ -60,6 +60,12 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     checkpoint_every_epochs: int = 10
     log_every_steps: int = 50
+    # Batches kept in flight on-device ahead of the step consuming them:
+    # device transfers are asynchronous, so depth>=1 overlaps the
+    # host->device copy of batch t+1 with the compute of batch t (the
+    # reference ships every batch synchronously, estimate.py:68-69).
+    # 0 disables prefetch.
+    prefetch_depth: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

@@ -10,6 +10,7 @@ from deeprest_tpu.parallel.sharding import (
 )
 from deeprest_tpu.parallel.distributed import (
     feed_global_batch,
+    prefetch_to_device,
     global_mesh,
     initialize_distributed,
     process_batch_slice,
@@ -23,6 +24,7 @@ __all__ = [
     "shard_batch",
     "shard_params",
     "feed_global_batch",
+    "prefetch_to_device",
     "global_mesh",
     "initialize_distributed",
     "process_batch_slice",

@@ -29,7 +29,7 @@ from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
-    feed_global_batch, feed_replicated, gather_to_host,
+    feed_replicated, gather_to_host, prefetch_to_device,
 )
 from deeprest_tpu.parallel.mesh import make_mesh
 from deeprest_tpu.parallel.sharding import shard_params
@@ -144,13 +144,16 @@ class Trainer:
         measuring = self._warmed
         if measuring:
             self.throughput.start()
-        for sel, weight in self._batches(len(bundle.x_train), epoch_rng):
-            # feed_global_batch: sharded device_put on one host; on a pod,
-            # each process ships only its process_batch_slice of the
-            # (identical, rng-deterministic) global selection.
-            xb = feed_global_batch(self.mesh, bundle.x_train[sel])
-            yb = feed_global_batch(self.mesh, bundle.y_train[sel])
-            wb = feed_global_batch(self.mesh, weight)
+        def host_batches():
+            # feed_global_batch (inside prefetch): sharded device_put on one
+            # host; on a pod, each process ships only its process_batch_slice
+            # of the (identical, rng-deterministic) global selection.
+            for sel, weight in self._batches(len(bundle.x_train), epoch_rng):
+                yield bundle.x_train[sel], bundle.y_train[sel], weight
+
+        for xb, yb, wb in prefetch_to_device(
+                self.mesh, host_batches(),
+                depth=self.config.train.prefetch_depth):
             state, loss = self._train_step(state, xb, yb, wb)
             losses.append(loss)
             self._global_step += 1

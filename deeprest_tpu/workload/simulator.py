@@ -40,13 +40,21 @@ def simulate_corpus(
     simulate a different application.  The scenario's traffic matrix must be
     as wide as ``endpoints`` (use ``LoadScenario.generic_endpoints``).
     """
+    if endpoints is None:
+        if app is None:
+            endpoints = API_ENDPOINTS
+        else:
+            # A custom app must declare its surface — defaulting it to the
+            # social-network endpoint list could pass the width check by
+            # coincidence and fail deep in the bucket loop.
+            try:
+                endpoints = tuple(app.endpoints)
+            except AttributeError:
+                raise TypeError(
+                    "custom app has no .endpoints attribute; pass "
+                    "endpoints= explicitly") from None
     if app is None:
         app = SocialNetworkApp(app_params)
-    if endpoints is None:
-        # Derive from the app when it declares its surface — defaulting a
-        # custom app to the social-network endpoint list could pass the
-        # width check by coincidence and fail deep in the bucket loop.
-        endpoints = tuple(getattr(app, "endpoints", API_ENDPOINTS))
     trace_rng = np.random.default_rng(scenario.seed + 3)
     traffic = scenario.traffic(num_buckets)          # [T, num_endpoints]
     if traffic.shape[1] != len(endpoints):

@@ -240,3 +240,34 @@ def test_feed_global_batch_shards_on_data():
     np.testing.assert_array_equal(np.asarray(arr), local)
     # and it is directly consumable by the sharded trainer's step shape
     assert arr.addressable_shards[0].data.shape == (2, 3, 2)
+
+
+def test_prefetch_to_device_preserves_order_and_values():
+    from deeprest_tpu.parallel import global_mesh, prefetch_to_device
+
+    mesh = global_mesh(MeshConfig(data=8))
+    batches = [(np.full((8, 2), i, np.float32), np.arange(8, dtype=np.float32) + i)
+               for i in range(7)]
+    for depth in (0, 2, 10):          # sync, typical, deeper-than-stream
+        out = list(prefetch_to_device(mesh, iter(batches), depth=depth))
+        assert len(out) == len(batches)
+        for i, (xb, wb) in enumerate(out):
+            assert xb.sharding.spec == P("data", None)
+            np.testing.assert_array_equal(np.asarray(xb), batches[i][0])
+            np.testing.assert_array_equal(np.asarray(wb), batches[i][1])
+
+
+def test_training_identical_with_and_without_prefetch(bundle):
+    import dataclasses
+
+    losses = {}
+    for depth in (0, 3):
+        cfg = dataclasses.replace(
+            SMALL, train=dataclasses.replace(SMALL.train,
+                                             prefetch_depth=depth))
+        trainer = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+        state = trainer.init_state(bundle.x_train)
+        state, loss = trainer.train_epoch(state, bundle,
+                                          np.random.default_rng(0))
+        losses[depth] = loss
+    assert losses[0] == losses[3]      # prefetch must not change training
