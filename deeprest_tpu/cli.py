@@ -74,18 +74,29 @@ def _require_input(args, features_ok: bool = True):
 
 
 def cmd_simulate(args) -> int:
-    from deeprest_tpu.data.schema import save_raw_data_jsonl, save_raw_data_pickle
+    from deeprest_tpu.data.schema import save_raw_data_pickle
     from deeprest_tpu.workload.scenarios import SCENARIOS
-    from deeprest_tpu.workload.simulator import simulate_corpus
+    from deeprest_tpu.workload.simulator import (
+        build_synthetic_app, simulate_corpus, write_corpus_jsonl,
+    )
 
     scenario = SCENARIOS[args.scenario](args.seed)
-    buckets = simulate_corpus(scenario, args.ticks)
+    app = endpoints = None
+    if args.app == "synthetic":
+        app, endpoints = build_synthetic_app(scenario, args.services,
+                                             args.endpoints, args.seed)
     if args.out.endswith((".jsonl", ".jsl")):
-        save_raw_data_jsonl(buckets, args.out)
+        # streaming write: month-scale corpora never accumulate in memory
+        stats = write_corpus_jsonl(scenario, args.ticks, args.out,
+                                   app=app, endpoints=endpoints)
+        n = stats["buckets"]
     else:
+        buckets = simulate_corpus(scenario, args.ticks, app=app,
+                                  endpoints=endpoints)
         save_raw_data_pickle(buckets, args.out)
-    print(json.dumps({"scenario": args.scenario, "buckets": len(buckets),
-                      "out": args.out}))
+        n = len(buckets)
+    print(json.dumps({"scenario": args.scenario, "buckets": n,
+                      "app": args.app, "out": args.out}))
     return 0
 
 
@@ -494,6 +505,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ticks", type=int, default=480)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="raw_data.jsonl")
+    p.add_argument("--app", choices=("social", "synthetic"), default="social",
+                   help="topology: the 12-service social network or a seeded "
+                        "synthetic service DAG (TrainTicket scale)")
+    p.add_argument("--services", type=int, default=40,
+                   help="synthetic app: number of services")
+    p.add_argument("--endpoints", type=int, default=12,
+                   help="synthetic app: number of API endpoints")
     p.set_defaults(fn=cmd_simulate)
 
     p = sub.add_parser("featurize", help="raw corpus → model-ready features")

@@ -49,27 +49,46 @@ class DatasetBundle:
 
 
 def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
-    """Window, split, and normalize a featurized corpus."""
+    """Window, split, and normalize a featurized corpus.
+
+    Normalization happens on the BASE ``[T, F]``/``[T, E]`` series and the
+    windows are zero-copy strided views into the normalized series — never
+    a materialized ``[N, W, F]`` tensor, which at month-scale × 10k-endpoint
+    width would be ~100 GB (the reference materializes the stack,
+    estimate.py:26-27, at 480-bucket scale where it doesn't matter).  This
+    is exactly equivalent: min/max over the train windows equals min/max
+    over their union ``base[:split + w - 1]``, and scaling commutes with
+    window selection.
+    """
     w = config.window_size
-    x = sliding_windows(data.traffic, w)          # [N, W, F]
-    y = sliding_windows(data.targets(), w)        # [N, W, E]
-    split = int(len(x) * config.train_split)
-    if split < 1 or split >= len(x):
+    traffic = data.traffic                        # [T, F]
+    targets = data.targets()                      # [T, E]
+    n_windows = len(traffic) - w
+    if n_windows <= 0:
+        raise ValueError(
+            f"series of length {len(traffic)} too short for window_size={w}")
+    split = int(n_windows * config.train_split)
+    if split < 1 or split >= n_windows:
         raise ValueError(
             f"train_split={config.train_split} gives {split} train windows "
-            f"of {len(x)} total; corpus too short for window_size={w}"
+            f"of {n_windows} total; corpus too short for window_size={w}"
         )
 
-    x_stats = minmax_fit(x, split)                    # global, traffic
-    y_stats = minmax_fit(y, split, axis=(0, 1))       # per metric
-    x_n = x_stats.apply(x).astype(np.float32)
-    y_n = y_stats.apply(y).astype(np.float32)
+    base_span = split + w - 1   # union of the train windows' rows
+    x_stats = minmax_fit(traffic, base_span)                   # global
+    # [T, 1, E] view so the fitted stats keep the [1, E] broadcast shape
+    # the windowed path produced (checkpoint-sidecar compatibility).
+    y_stats = minmax_fit(targets[:, None, :], base_span, axis=(0, 1))
+    x_n = x_stats.apply(traffic).astype(np.float32)            # [T, F] copy
+    y_n = y_stats.apply(targets).astype(np.float32)
+    x = sliding_windows(x_n, w)                   # [N, W, F] view
+    y = sliding_windows(y_n, w)                   # [N, W, E] view
 
     return DatasetBundle(
-        x_train=x_n[:split],
-        y_train=y_n[:split],
-        x_test=x_n[split:],
-        y_test=y_n[split:],
+        x_train=x[:split],
+        y_train=y[:split],
+        x_test=x[split:],
+        y_test=y[split:],
         x_stats=x_stats,
         y_stats=y_stats,
         metric_names=list(data.metric_names),

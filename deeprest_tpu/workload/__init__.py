@@ -8,8 +8,11 @@ training job, but the *capability* it provides — realistic span trees and
 traffic-correlated per-component resource series, under controllable load
 scenarios including anomalies — is reproduced here as a deterministic,
 seedable simulator emitting the exact raw-data contract the data plane
-consumes.  (A native C++ fast path for month-scale corpora is planned under
-native/ — see the roadmap in README.md.)
+consumes.  Month-scale corpora stream bucket-by-bucket to JSONL
+(:func:`simulator.simulate_corpus_iter`, constant memory) and are
+featurized by the native C++ ETL (deeprest_tpu.data.native, ~25x the
+Python span walk) — see benchmarks/month_scale.py for the full 30-day
+pipeline.
 """
 
 from deeprest_tpu.workload.topology import SocialNetworkApp, API_ENDPOINTS

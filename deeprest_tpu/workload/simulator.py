@@ -76,6 +76,10 @@ def simulate_corpus(
         per_bucket_traces.append(traces)
         per_bucket_counts.append((ops, writes))
         components.update(ops)
+    # An app that declares its full graph (synthetic topologies) gets metric
+    # keys for every deployed component, invoked or idle — like a real
+    # scrape — and keeps the keyset identical to simulate_corpus_iter's.
+    components.update(getattr(app, "components", ()))
 
     # Phase 2: stateful telemetry over the full component set.
     model = ResourceModel(
@@ -88,6 +92,134 @@ def simulate_corpus(
                traces=traces)
         for traces, (ops, writes) in zip(per_bucket_traces, per_bucket_counts)
     ]
+
+
+def simulate_corpus_iter(
+    scenario: LoadScenario,
+    num_buckets: int,
+    app_params: AppParams | None = None,
+    anomalies: list[Anomaly] | None = None,
+    resource_seed: int | None = None,
+    app=None,
+    endpoints: tuple[str, ...] | None = None,
+    components: tuple[str, ...] | None = None,
+    discovery_buckets: int = 120,
+):
+    """Constant-memory variant of :func:`simulate_corpus`: yields buckets
+    one at a time, so month-scale corpora stream straight to JSONL without
+    ever holding tens of millions of span objects.
+
+    The fixed metric keyset every bucket must carry comes from (in order):
+    ``components``, the app's declared ``components`` attribute (synthetic
+    topologies know their full graph), or a discovery pre-pass over the
+    first ``discovery_buckets`` buckets (re-generated deterministically; a
+    component whose first appearance is later than that would be missing
+    from the keyset — pass ``components`` explicitly for apps with very
+    rare branches).
+
+    Identical RNG draw order to :func:`simulate_corpus`, so for an equal
+    component set the streamed corpus is bit-identical to the in-memory
+    one.
+    """
+    # Plain function (not a generator): every argument error surfaces HERE,
+    # before any caller opens/truncates an output file on the iterator's
+    # behalf.
+    if endpoints is None:
+        if app is None:
+            endpoints = API_ENDPOINTS
+        else:
+            try:
+                endpoints = tuple(app.endpoints)
+            except AttributeError:
+                raise TypeError(
+                    "custom app has no .endpoints attribute; pass "
+                    "endpoints= explicitly") from None
+    if app is None:
+        app = SocialNetworkApp(app_params)
+    traffic = scenario.traffic(num_buckets)
+    if traffic.shape[1] != len(endpoints):
+        raise ValueError(
+            f"scenario emits {traffic.shape[1]}-endpoint traffic but the app "
+            f"has {len(endpoints)} endpoints — set scenario.generic_endpoints")
+
+    if components is None:
+        components = getattr(app, "components", None)
+    if components is None:
+        # Discovery pre-pass: regenerate the first K buckets with a scratch
+        # rng (same seed → same traces) and union their component sets.
+        scratch_rng = np.random.default_rng(scenario.seed + 3)
+        seen: set[str] = set()
+        for t in range(min(num_buckets, discovery_buckets)):
+            traces = []
+            for api_idx, api in enumerate(endpoints):
+                for _ in range(int(traffic[t, api_idx])):
+                    traces.extend(app.generate(api, scratch_rng))
+            ops, _ = count_ops(traces)
+            seen.update(ops)
+        components = tuple(seen)
+    return _corpus_gen(scenario, num_buckets, anomalies, resource_seed, app,
+                       endpoints, traffic, sorted(components))
+
+
+def _corpus_gen(scenario, num_buckets, anomalies, resource_seed, app,
+                endpoints, traffic, ordered):
+    comp_set = set(ordered)
+    trace_rng = np.random.default_rng(scenario.seed + 3)
+    model = ResourceModel(
+        seed=scenario.seed if resource_seed is None else resource_seed,
+        anomalies=anomalies,
+    )
+    for t in range(num_buckets):
+        traces = []
+        for api_idx, api in enumerate(endpoints):
+            for _ in range(int(traffic[t, api_idx])):
+                traces.extend(app.generate(api, trace_rng))
+        ops, writes = count_ops(traces)
+        # Fail FAST on a component outside the fixed keyset (first seen
+        # after the discovery window): emitting it would make this bucket's
+        # metric keys diverge and poison the whole corpus for featurization.
+        unknown = set(ops) - comp_set
+        if unknown:
+            raise ValueError(
+                f"bucket {t}: components {sorted(unknown)} first appear "
+                f"after the discovery window — pass components= explicitly "
+                "or raise discovery_buckets")
+        yield Bucket(metrics=model.step_counts(ops, writes, components=ordered),
+                     traces=traces)
+
+
+def build_synthetic_app(scenario: LoadScenario, num_services: int,
+                        num_endpoints: int, seed: int):
+    """Construct the synthetic topology for a CLI run and point the
+    scenario's composition at its endpoint surface.  Shared by the two
+    simulate CLIs (this module's main and deeprest_tpu.cli simulate)."""
+    from deeprest_tpu.workload.microtopo import (
+        SyntheticMicroserviceApp, TopologyParams,
+    )
+
+    app = SyntheticMicroserviceApp(TopologyParams(
+        num_services=num_services, num_endpoints=num_endpoints, seed=seed))
+    scenario.generic_endpoints = len(app.endpoints)
+    return app, app.endpoints
+
+
+def write_corpus_jsonl(scenario: LoadScenario, num_buckets: int,
+                       out_path: str, app=None, endpoints=None,
+                       anomalies=None) -> dict:
+    """Stream a corpus to JSONL at constant memory; returns counts."""
+    stats = {"buckets": 0, "traces": 0, "metric_keys": 0}
+    it = simulate_corpus_iter(scenario, num_buckets, anomalies=anomalies,
+                              app=app, endpoints=endpoints)
+
+    def counted():
+        for b in it:
+            stats["buckets"] += 1
+            stats["traces"] += len(b.traces)
+            stats["metric_keys"] = len(b.metrics)
+            yield b
+
+    save_raw_data_jsonl(counted(), out_path)
+    return stats
 
 
 def parse_anomaly(spec: str) -> Anomaly:
@@ -127,24 +259,24 @@ def main(argv: list[str] | None = None) -> None:
     scenario.calls_per_user = args.calls_per_user
     app = endpoints = None
     if args.app == "synthetic":
-        from deeprest_tpu.workload.microtopo import (
-            SyntheticMicroserviceApp, TopologyParams,
-        )
-
-        app = SyntheticMicroserviceApp(TopologyParams(
-            num_services=args.services, num_endpoints=args.endpoints,
-            seed=args.seed))
-        endpoints = app.endpoints
-        scenario.generic_endpoints = len(endpoints)
-    buckets = simulate_corpus(scenario, args.buckets, anomalies=args.anomaly,
-                              app=app, endpoints=endpoints)
+        app, endpoints = build_synthetic_app(scenario, args.services,
+                                             args.endpoints, args.seed)
     if args.out.endswith(".pkl"):
+        buckets = simulate_corpus(scenario, args.buckets,
+                                  anomalies=args.anomaly,
+                                  app=app, endpoints=endpoints)
         save_raw_data_pickle(buckets, args.out)
+        stats = {"buckets": len(buckets),
+                 "traces": sum(len(b.traces) for b in buckets),
+                 "metric_keys": len(buckets[0].metrics)}
     else:
-        save_raw_data_jsonl(buckets, args.out)
-    total_traces = sum(len(b.traces) for b in buckets)
-    print(f"wrote {len(buckets)} buckets, {total_traces} traces, "
-          f"{len(buckets[0].metrics)} metric keys -> {args.out}")
+        # JSONL streams bucket-by-bucket: month-scale corpora never hold
+        # more than one bucket of span objects in memory.
+        stats = write_corpus_jsonl(scenario, args.buckets, args.out,
+                                   app=app, endpoints=endpoints,
+                                   anomalies=args.anomaly)
+    print(f"wrote {stats['buckets']} buckets, {stats['traces']} traces, "
+          f"{stats['metric_keys']} metric keys -> {args.out}")
 
 
 if __name__ == "__main__":

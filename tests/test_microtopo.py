@@ -117,3 +117,26 @@ def test_scenario_width_mismatch_is_loud():
     bad = LoadScenario(name="bad", seed=0)    # social 6-endpoint traffic
     with pytest.raises(ValueError, match="generic_endpoints"):
         simulate_corpus(bad, 5, app=app, endpoints=app.endpoints)
+
+
+def test_streaming_simulation_matches_in_memory():
+    """simulate_corpus_iter must produce bit-identical buckets to
+    simulate_corpus when the component sets agree (synthetic apps declare
+    theirs; the social app relies on the discovery pre-pass)."""
+    from deeprest_tpu.workload.simulator import simulate_corpus_iter
+
+    # synthetic app: declared component set, exact match guaranteed
+    app = _app(num_services=24, num_endpoints=8)
+    sc = _scenario(app)
+    mem = simulate_corpus(sc, 12, app=app, endpoints=app.endpoints)
+    stream = list(simulate_corpus_iter(sc, 12, app=app,
+                                       endpoints=app.endpoints))
+    assert [b.to_dict() for b in mem] == [b.to_dict() for b in stream]
+
+    # social app: discovery prefix covers the component set at this scale
+    from deeprest_tpu.workload import normal_scenario
+
+    sc2 = normal_scenario(seed=2)
+    mem2 = simulate_corpus(sc2, 12)
+    stream2 = list(simulate_corpus_iter(sc2, 12))
+    assert [b.to_dict() for b in mem2] == [b.to_dict() for b in stream2]

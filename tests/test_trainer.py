@@ -162,3 +162,41 @@ def test_throughput_excludes_compile(bundle):
     assert trainer.throughput.steps == n_batches - 1
     state, _ = trainer.train_epoch(state, bundle, np.random.default_rng(1))
     assert trainer.throughput.steps == 2 * n_batches - 1
+
+
+def test_prepare_dataset_windows_are_views_not_copies():
+    """Month-scale corpora depend on windows being strided views into the
+    normalized base series — materializing [N, W, F] would be ~100 GB at
+    30-day x 10k-endpoint scale."""
+    import tracemalloc
+
+    rng = np.random.default_rng(3)
+    t, f = 4000, 512
+
+    class FD:
+        traffic = rng.random((t, f)).astype(np.float32)
+        _targets = rng.random((t, 5)).astype(np.float32)
+        metric_names = ["a", "b", "c", "d", "e"]
+
+        def targets(self):
+            return self._targets
+
+        class space:
+            @staticmethod
+            def to_dict():
+                return {}
+
+    tracemalloc.start()
+    b = prepare_dataset(FD(), TrainConfig(window_size=60))
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    base_bytes = t * f * 4
+    windows_bytes = (t - 60) * 60 * f * 4
+    assert peak < 3 * base_bytes            # a couple of base copies, fine
+    assert peak < windows_bytes / 10        # nowhere near materialized windows
+    # windows are views into one normalized base buffer
+    assert b.x_train.base is not None
+    assert np.shares_memory(b.x_train, b.x_test)
+    # and batch selection still copies just the batch
+    sel = b.x_train[[0, 5, 2]]
+    assert sel.base is None or not np.shares_memory(sel, b.x_train)
