@@ -148,7 +148,16 @@ def _gru_pallas(
         h0 = jnp.pad(h0, ((0, e_pad), (0, 0), (0, 0)))
     if reverse:
         proj = jnp.flip(proj, axis=1)
+    # Pad the time axis (AFTER the flip, so padding sits at the END of scan
+    # order) up to a T_BLK multiple; the tail steps compute values beyond
+    # every real output and are sliced off — in the VJP their incoming
+    # gradients are exactly zero, so they contribute nothing.
+    t_pad = pallas_gru.pad_time(t) - t
+    if t_pad:
+        proj = jnp.pad(proj, ((0, 0), (0, t_pad), (0, 0), (0, 0)))
     h_all = pallas_gru.gru_recurrence(proj, w_hh, b_hh, h0, interpret)
+    if t_pad:
+        h_all = h_all[:, :t]
     if reverse:
         h_all = jnp.flip(h_all, axis=1)
     h_all = h_all[:e, :, :b]

@@ -46,6 +46,26 @@ def test_forward_aligned_shapes():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("t", [1, 2, 6, 12])
+def test_time_blocking_boundaries(t):
+    # T below / equal to / a multiple of T_BLK: padding and the in-program
+    # time loop must agree with scan in both directions, values and grads.
+    params, x, _ = _setup(t=t)
+
+    def loss(backend, x):
+        fwd = gru(params, x, backend=backend)
+        rev = gru(params, x, reverse=True, backend=backend)
+        return jnp.sum(fwd ** 2) + jnp.sum(jnp.sin(rev))
+
+    np.testing.assert_allclose(
+        float(loss("pallas_interpret", x)), float(loss("scan", x)),
+        rtol=1e-5)
+    g_ref = jax.grad(lambda x: loss("scan", x))(x)
+    g_pl = jax.grad(lambda x: loss("pallas_interpret", x))(x)
+    np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_gradients_match_scan():
     params, x, _ = _setup()
 
