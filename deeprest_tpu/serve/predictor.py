@@ -19,13 +19,17 @@ from deeprest_tpu.models.qrnn import QuantileGRU
 
 
 def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
-                      window_size: int, traffic: np.ndarray) -> np.ndarray:
+                      window_size: int, traffic: np.ndarray,
+                      max_batch: int = 64) -> np.ndarray:
     """[T, F] raw traffic → de-normalized [T, E, Q] predictions.
 
     The series is tiled into non-overlapping windows (last window
     right-aligned so every step is covered exactly once; the recurrent
     core supports any duration — reference claim at
-    resource-estimation/README.md:83).  Shared by the in-process
+    resource-estimation/README.md:83).  Windows go through ``apply_fn``
+    in batches of at most ``max_batch``, so memory stays bounded for
+    arbitrarily long series (a month of minutes is ~720 windows; only one
+    batch of them is ever resident on device).  Shared by the in-process
     Predictor and the exported-artifact loader so both serve identical
     semantics by construction.
     """
@@ -36,16 +40,20 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
     starts = list(range(0, t - w + 1, w))
     if starts[-1] != t - w:
         starts.append(t - w)
-    x = np.stack([traffic[s:s + w] for s in starts]).astype(np.float32)
-    x = x_stats.apply(x).astype(np.float32)
-    preds = np.asarray(apply_fn(x))                       # [N, W, E, Q]
-    preds = y_stats.invert(
-        np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
-    ).transpose(0, 1, 3, 2)
 
-    out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
-    for s, window in zip(starts, preds):
-        out[s:s + w] = window          # later (right-aligned) window wins
+    out = None
+    for lo in range(0, len(starts), max_batch):
+        chunk = starts[lo:lo + max_batch]
+        x = np.stack([traffic[s:s + w] for s in chunk]).astype(np.float32)
+        x = x_stats.apply(x).astype(np.float32)
+        preds = np.asarray(apply_fn(x))                   # [n, W, E, Q]
+        preds = y_stats.invert(
+            np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
+        ).transpose(0, 1, 3, 2)
+        if out is None:
+            out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
+        for s, window in zip(chunk, preds):
+            out[s:s + w] = window      # later (right-aligned) window wins
     return out
 
 

@@ -103,3 +103,20 @@ def test_anomaly_detection_end_to_end(trained):
     clean_obs = np.stack([clean_data.resources[m] for m in bundle.metric_names], -1)
     clean_reports = {r.metric: r for r in detector.check(clean_data.traffic, clean_obs)}
     assert clean_reports[f"{victim}_cpu"].score < reports[f"{victim}_cpu"].score
+
+
+def test_rolled_prediction_batching_invariant(trained):
+    """Chunked window batching (bounded memory for arbitrary-duration
+    series) must produce identical predictions to one big batch."""
+    from deeprest_tpu.serve.predictor import rolled_prediction
+
+    corpus, space, data, bundle, trainer, state, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    traffic = data.traffic[:75]          # 6 windows of 12 + ragged tail
+    apply = lambda x: pred._apply(pred.params, x)
+    big = rolled_prediction(apply, pred.x_stats, pred.y_stats,
+                            pred.window_size, traffic, max_batch=4096)
+    small = rolled_prediction(apply, pred.x_stats, pred.y_stats,
+                              pred.window_size, traffic, max_batch=2)
+    # not bit-equal: XLA fuses differently per compiled batch shape
+    np.testing.assert_allclose(small, big, rtol=1e-3, atol=1e-4)

@@ -488,3 +488,28 @@ def test_checkpoint_retention_bounds_disk(tmp_path):
     assert len(steps) == 2                   # pruned to the retention bound
     st2 = make_trainer(ckpt_dir=ckpt)        # newest survivor resumes
     assert st2._refresh_count == 4
+
+
+def test_tailer_bounded_poll_drains_backlog(tmp_path):
+    """A large pre-existing backlog must stream through the read cap in
+    multiple polls (bounded memory), preserving order and completeness."""
+    path = str(tmp_path / "big.jsonl")
+    buckets = make_series_buckets(30, seed=4)
+    from deeprest_tpu.data.schema import save_raw_data_jsonl
+
+    save_raw_data_jsonl(buckets, path)
+    line_len = len(open(path, "rb").readline())
+    tailer = BucketTailer(path, max_poll_bytes=3 * line_len)
+
+    got, polls = [], 0
+    while True:
+        batch = tailer.poll()
+        if not batch and not tailer.backlog:
+            break
+        polls += 1
+        got.extend(batch)
+    assert polls > 3                          # actually chunked
+    assert len(got) == 30
+    assert [b.to_dict() for b in got] == [b.to_dict() for b in buckets]
+    assert tailer.backlog is False
+    assert tailer.dropped == 0
