@@ -140,8 +140,9 @@ def main():
     data.metric_names = metric_names
     data.space = space
 
+    feat_dim = int(traffic.shape[1])     # from the data, not the constant
     cfg = Config(
-        model=ModelConfig(feature_dim=F_CAP, num_metrics=N_METRICS,
+        model=ModelConfig(feature_dim=feat_dim, num_metrics=N_METRICS,
                           hidden_size=128, compute_dtype="bfloat16"),
         train=TrainConfig(batch_size=32, window_size=60,
                           num_epochs=args.epochs, log_every_steps=0, seed=0),
@@ -150,7 +151,7 @@ def main():
     print(f"windows: {bundle.split} train / {len(bundle.x_test)} test "
           f"(views into {traffic.nbytes / 1e9:.2f} GB base)", flush=True)
 
-    trainer = Trainer(cfg, F_CAP, metric_names)
+    trainer = Trainer(cfg, feat_dim, metric_names)
     t0 = time.perf_counter()
     state, history = trainer.fit(bundle)
     t_train = time.perf_counter() - t0
@@ -160,7 +161,7 @@ def main():
 
     dev = jax.devices()[0]
     result = {
-        "corpus": {"buckets": int(len(traffic)), "feature_dim": F_CAP,
+        "corpus": {"buckets": int(len(traffic)), "feature_dim": feat_dim,
                    "distinct_paths_hashed": "hash-mode (no vocabulary)",
                    "metrics_total": len(keys),
                    "metrics_trained": len(metric_names)},
