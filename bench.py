@@ -38,6 +38,11 @@ B, T, F, E, H = 32, 60, 512, 40, 128
 Q = 3                       # quantiles (.05, .50, .95)
 F_10K = 10240               # the 10k-endpoint width (BASELINE.json configs[3])
 BASELINE_CACHE = os.path.join(REPO, "bench_baseline.json")
+# Most recent successful on-TPU headline, committed so a tunnel-down run
+# still reports an honest, labeled TPU number (round-3: the tunnel wedged
+# for ~10h and the round's only artifact was a CPU fallback).
+LAST_GOOD_TPU = os.path.join(REPO, "benchmarks", "last_good_tpu.json")
+LAST_GOOD_FALLBACKS = (os.path.join(REPO, "benchmarks", "bench_snapshot_r3.json"),)
 
 # Peak bf16 TFLOP/s per chip, keyed by device_kind substring (public specs).
 # Used to turn measured steps/s into an absolute MFU anchor — the judge's
@@ -143,9 +148,11 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     # `jax.block_until_ready` does NOT reliably synchronize with device
     # execution — a timing loop "synced" that way measures dispatch rate
     # (hundreds of fake steps/s).  The only primitive that provably
-    # round-trips is a host readback, so every trial ends with
-    # `float(loss)` and the steps-per-trial count amortizes that ~60ms
-    # round trip.  Inputs are staged on device ONCE: the headline is
+    # round-trips is a host readback, so every trial ends with one — of an
+    # element of the UPDATED params (sync_leaf below), which forces the
+    # whole step including the optimizer update; the loss would not, being
+    # computed before the update — and the steps-per-trial count amortizes
+    # that ~60ms round trip.  Inputs are staged on device ONCE: the headline is
     # compute throughput with data resident in HBM (what an input
     # pipeline sustains in steady state); the per-step host-feed cost is
     # measured separately below and reported as `host_feed_steps_per_sec`.
@@ -175,13 +182,19 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     if not np.isfinite(lv):
         raise RuntimeError(f"non-finite bench loss {lv}")
 
+    # Trial sync reads back an element of the UPDATED params, not the loss:
+    # the loss is computed before the optimizer update inside the step, so a
+    # loss readback would leave the final step's parameter update outside
+    # the timed region (~1% flattering at 100 steps/trial).
+    sync_leaf = lambda s: float(jnp.ravel(jax.tree.leaves(s.params)[0])[0])
     best = 0.0
     for _ in range(sizes["trials"]):
         t0 = time.perf_counter()
         for _ in range(sizes["steps"]):
             state, loss = trainer._train_step(state, x_d, y_d, w_d)
-        lv = float(loss)                           # sync: host readback
+        _ = sync_leaf(state)                       # sync: host readback
         best = max(best, sizes["steps"] / (time.perf_counter() - t0))
+    lv = float(loss)
     if not np.isfinite(lv):
         raise RuntimeError(f"non-finite bench loss {lv}")
 
@@ -192,7 +205,7 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     t0 = time.perf_counter()
     for _ in range(host_steps):
         state, loss = trainer._train_step(state, x, y, w)
-    float(loss)
+    _ = sync_leaf(state)
     host_sps = host_steps / (time.perf_counter() - t0)
     dev = jax.devices()[0]
     out = {
@@ -363,6 +376,60 @@ def _mfu_block(measured: dict, features: int) -> dict:
     return block
 
 
+def _git_sha() -> str | None:
+    try:
+        # --dirty: a snapshot measured from an uncommitted tree must not be
+        # attributed to the clean HEAD commit (it would send a bisecting
+        # maintainer to code that did not produce the number).
+        out = subprocess.run(["git", "describe", "--always", "--dirty"],
+                             capture_output=True, text=True, cwd=REPO,
+                             timeout=10)
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _load_last_good_tpu() -> dict | None:
+    """The most recent committed on-TPU headline, oldest-compatible format."""
+    for path in (LAST_GOOD_TPU, *LAST_GOOD_FALLBACKS):
+        try:
+            with open(path, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        out = {
+            "steps_per_sec": snap.get("value"),
+            "unit": snap.get("unit"),
+            "mfu_pct": (snap.get("perf") or {}).get("mfu_pct"),
+            "sustained_tflops": (snap.get("perf") or {}).get("sustained_tflops"),
+            "chip": (snap.get("perf") or {}).get("chip"),
+            "git_sha": snap.get("git_sha"),
+            "recorded_utc": snap.get("recorded_utc"),
+            "source": os.path.relpath(path, REPO),
+        }
+        if "tenk_endpoint" in snap and "error" not in snap["tenk_endpoint"]:
+            out["tenk_mfu_pct"] = snap["tenk_endpoint"].get("mfu_pct")
+        return out
+    return None
+
+
+def _save_last_good_tpu(result: dict) -> None:
+    snap = dict(result)
+    snap["git_sha"] = _git_sha()
+    snap["recorded_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        # tmp + rename: a bench killed mid-write (wedged tunnel, driver
+        # timeout — the exact conditions this file exists to survive) must
+        # not destroy the previous good snapshot.
+        tmp = LAST_GOOD_TPU + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=2)
+        os.replace(tmp, LAST_GOOD_TPU)
+    except OSError as exc:
+        print(f"bench: could not persist last-good snapshot: {exc}",
+              file=sys.stderr)
+
+
 def main() -> None:
     measured, tpu_error = _measure_with_fallback()
     jax_sps = float(measured["steps_per_sec"])
@@ -379,27 +446,41 @@ def main() -> None:
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
                 f"{measured.get('dtype', 'bfloat16')})",
-        # vs_baseline stays for the driver's schema, but the absolute anchor
-        # is `perf` (sustained TFLOP/s + MFU); the torch-CPU ratio is a
-        # footnote — it measures nothing the north star cares about.
-        "vs_baseline": round(jax_sps / torch_sps, 3) if torch_sps > 0 else None,
+        # The absolute anchor is `perf` (sustained TFLOP/s + MFU vs the
+        # chip's public bf16 peak).  The A100 ratio the north star names is
+        # explicitly unmeasurable here — no GPU is attached to this host —
+        # and saying so beats publishing a number that invites misreading.
         "perf": perf,
+        "a100_ratio": "unmeasurable on this host (no GPU attached; "
+                      "use perf.mfu_pct as the absolute anchor)",
+        # vs_baseline stays for the driver's schema, demoted below perf: the
+        # torch-CPU ratio measures nothing the north star cares about.
+        "vs_baseline": round(jax_sps / torch_sps, 3) if torch_sps > 0 else None,
         "footnote_torch_cpu_anchor": (
             f"vs_baseline is torch-CPU ({torch_sps:.4f} steps/s over "
             f"{TORCH_STEPS} steps, reference-equivalent model) — the "
             "reference publishes no throughput and no GPU exists on this "
             "host; use perf.mfu_pct as the absolute anchor"),
         "measurement_note": (
-            "Round-3 fix: earlier rounds synced trials with "
-            "jax.block_until_ready, which does NOT wait for execution on "
-            "the tunneled TPU backend — those numbers (e.g. r02's 275.9 "
-            "steps/s) measured dispatch rate, not compute. Trials now end "
-            "with a host readback of the loss (provably synchronizing) "
-            "and inputs are staged in HBM once; the separately-reported "
+            "Honest-sync measurement: every trial ends with a host readback "
+            "of an updated-params element (jax.block_until_ready does NOT "
+            "wait for execution on the tunneled TPU backend — round-2's "
+            "275.9 steps/s was dispatch rate, not compute) and inputs are "
+            "staged in HBM once; the separately-reported "
             "host_feed_steps_per_sec covers the host->device feed path."),
     }
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
+    if measured.get("rnn_backend_fallback"):
+        # Surface a pallas→scan degrade in the headline record: this number
+        # does not represent the production kernel path.
+        result["rnn_backend_fallback"] = measured["rnn_backend_fallback"]
+    if platform == "cpu":
+        # Tunnel-down degrade: carry the last committed on-TPU headline
+        # (value, MFU, git sha, age) instead of "no TPU number at all".
+        last_good = _load_last_good_tpu()
+        if last_good is not None:
+            result["last_good_tpu"] = last_good
 
     # 10k-endpoint config (BASELINE.json configs[3]): single-chip step time
     # + HBM at F=10240. Only meaningful on the accelerator.
@@ -419,6 +500,11 @@ def main() -> None:
     pallas = _maybe_pallas_proof(platform)
     if pallas is not None:
         result["pallas_tpu"] = pallas
+    if platform != "cpu" and "rnn_backend_fallback" not in result:
+        # A scan-degraded run must not clobber the last-good snapshot: when
+        # the tunnel next wedges, "last good" would present a regressed
+        # number as the healthy on-TPU headline.
+        _save_last_good_tpu(result)
     print(json.dumps(result))
 
 

@@ -74,10 +74,32 @@ class Burner:
         # chain costs ~2s of child startup, during which a short burn window
         # would produce zero attributed samples.  The file itself only needs
         # the stdlib, so the child starts hashing almost immediately.
-        cmd = [sys.executable, os.path.abspath(__file__),
-               f"--duration={self.duration_s}"]
+        # In a zipped install __file__ is not a real on-disk path — fall
+        # back to the (slower) -m invocation, with the package's import
+        # root (the zip itself) put on the child's PYTHONPATH: the child
+        # does not inherit the parent's sys.path, so without this the -m
+        # child would die instantly on ModuleNotFoundError into DEVNULL
+        # and the anomaly would silently inject zero load.  (A PyInstaller
+        # freeze, where sys.executable is not a Python interpreter at all,
+        # is not supported.)
+        script = os.path.abspath(__file__)
+        env = None
+        if os.path.isfile(script):
+            cmd = [sys.executable, script, f"--duration={self.duration_s}"]
+        else:
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(script)))
+            env = dict(os.environ)
+            # No trailing empty entry: CPython reads one as "cwd", which
+            # could shadow the real package with a stray checkout.
+            existing = env.get("PYTHONPATH")
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                 if existing else pkg_root)
+            cmd = [sys.executable, "-m", "deeprest_tpu.loadgen.burner",
+                   f"--duration={self.duration_s}"]
         self._proc = subprocess.Popen(
             cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env,
         )
         if self.collector_addr and self.component:
             # Register from the parent — the child pid is known the moment

@@ -111,11 +111,20 @@ def simulate_corpus_iter(
 
     The fixed metric keyset every bucket must carry comes from (in order):
     ``components``, the app's declared ``components`` attribute (synthetic
-    topologies know their full graph), or a discovery pre-pass over the
-    first ``discovery_buckets`` buckets (re-generated deterministically; a
-    component whose first appearance is later than that would be missing
-    from the keyset — pass ``components`` explicitly for apps with very
-    rare branches).
+    topologies know their full graph), or a discovery pre-pass of
+    ``discovery_buckets`` buckets re-generated deterministically — the
+    series prefix plus a stride/peak-traffic sample across the whole run,
+    so a branch that only fires under late peak load is still likely in
+    the keyset.  Discovery is sampling, not proof: pass ``components``
+    explicitly for apps with very rare branches (the generator fail-fasts
+    on any component outside the keyset rather than poisoning the corpus).
+    Two sampling caveats, accepted deliberately: tier-2 buckets are
+    re-generated with per-bucket rngs whose draws differ from the real
+    pass, so (rarely) a discovered component may never occur in the actual
+    corpus — its metric key is then present but always idle — and the
+    bit-identity with :func:`simulate_corpus` noted below is therefore
+    guaranteed only when the component set comes from ``components=`` or
+    the app, not from discovery on a series longer than the prefix.
 
     Identical RNG draw order to :func:`simulate_corpus`, so for an equal
     component set the streamed corpus is bit-identical to the in-memory
@@ -145,17 +154,46 @@ def simulate_corpus_iter(
     if components is None:
         components = getattr(app, "components", None)
     if components is None:
-        # Discovery pre-pass: regenerate the first K buckets with a scratch
-        # rng (same seed → same traces) and union their component sets.
+        # Discovery pre-pass, two tiers sharing the budget:
+        #   1. the series PREFIX, regenerated with the same sequential rng
+        #      the real pass uses (same seed → bit-identical traces), so
+        #      everything in those buckets is in the keyset by construction;
+        #   2. buckets SAMPLED ACROSS the whole series — an even stride plus
+        #      the highest-traffic buckets — each with a per-bucket rng.
+        # Tier 2 exists because a rare branch can first fire deep into a
+        # month-scale run (e.g. only under peak traffic); a prefix-only
+        # pre-pass would then fail-fast in _corpus_gen hours in, after the
+        # caller has already streamed a large partial JSONL.  Peak buckets
+        # see the most traces, so they are the best places to observe rare
+        # branches.
+        # The full budget still goes to the prefix (so every run that was
+        # safe before stays safe by construction); tier 2 ADDS up to
+        # budget//2 sampled buckets on top.
+        prefix_n = min(num_buckets, discovery_buckets)
         scratch_rng = np.random.default_rng(scenario.seed + 3)
         seen: set[str] = set()
-        for t in range(min(num_buckets, discovery_buckets)):
+
+        def observe(t: int, rng) -> None:
             traces = []
             for api_idx, api in enumerate(endpoints):
                 for _ in range(int(traffic[t, api_idx])):
-                    traces.extend(app.generate(api, scratch_rng))
+                    traces.extend(app.generate(api, rng))
             ops, _ = count_ops(traces)
             seen.update(ops)
+
+        for t in range(prefix_n):
+            observe(t, scratch_rng)
+        rest = discovery_buckets // 2 if num_buckets > prefix_n else 0
+        if rest > 0:
+            stride = np.linspace(prefix_n, num_buckets - 1,
+                                 num=rest // 2, dtype=np.int64)
+            # Peak candidates come from BEYOND the prefix (an early-peaking
+            # series must not consume the peak budget on buckets the prefix
+            # already covered).
+            tail_traffic = traffic[prefix_n:].sum(axis=1)
+            peak = prefix_n + np.argsort(tail_traffic)[::-1][:rest - len(stride)]
+            for t in sorted(set(stride.tolist()) | set(peak.tolist())):
+                observe(int(t), np.random.default_rng((scenario.seed + 3, int(t))))
         components = tuple(seen)
     return _corpus_gen(scenario, num_buckets, anomalies, resource_seed, app,
                        endpoints, traffic, sorted(components))

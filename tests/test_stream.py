@@ -99,6 +99,94 @@ def test_tailer_handles_missing_then_created_file(tmp_path):
     assert len(tailer.poll()) == 3
 
 
+def _drain(tailer, max_polls=2000):
+    """Poll until quiescent.  Two consecutive idle polls are required:
+    the grace poll before a generation switch is idle-with-backlog-False
+    by design (run() covers it with its sleep interval)."""
+    got, idle = [], 0
+    for _ in range(max_polls):
+        batch = tailer.poll()
+        got.extend(batch)
+        idle = idle + 1 if not batch and not tailer.backlog else 0
+        if idle == 2:
+            return got
+    raise AssertionError("tailer never drained")
+
+
+def test_tailer_rename_rotation_is_zero_loss_mid_backlog(tmp_path):
+    """A rename rotation while the tailer is still draining a capped
+    backlog must lose nothing: the held fd keeps the old inode readable."""
+    path = str(tmp_path / "raw.jsonl")
+    buckets = make_series_buckets(12, seed=2)
+    line = _bucket_line(buckets[0])
+    save_raw_data_jsonl(buckets[:9], path)
+    tailer = BucketTailer(path, max_poll_bytes=2 * len(line))
+    first = tailer.poll()
+    assert tailer.backlog                      # capped: backlog remains
+    os.rename(path, path + ".old")             # rotation mid-drain
+    save_raw_data_jsonl(buckets[9:], path)
+    got = first + _drain(tailer)
+    assert [b.to_dict() for b in got] == [b.to_dict() for b in buckets]
+    assert tailer.truncated_events == 0 and tailer.dropped == 0
+
+
+def test_tailer_double_rotation_queues_generations(tmp_path):
+    """A second rotation during the drain of the first must queue, not
+    drop, the intermediate generation."""
+    path = str(tmp_path / "raw.jsonl")
+    buckets = make_series_buckets(9, seed=3)
+    line = _bucket_line(buckets[0])
+    save_raw_data_jsonl(buckets[:5], path)
+    tailer = BucketTailer(path, max_poll_bytes=len(line))
+    got = tailer.poll()
+    os.rename(path, path + ".g1")
+    save_raw_data_jsonl(buckets[5:7], path)    # gen 2
+    got += tailer.poll()                       # sees + queues gen 2
+    os.rename(path, path + ".g2")
+    save_raw_data_jsonl(buckets[7:], path)     # gen 3
+    got += _drain(tailer)
+    assert [b.to_dict() for b in got] == [b.to_dict() for b in buckets]
+
+
+def test_tailer_grace_covers_writer_that_keeps_fd_after_rotation(tmp_path):
+    """Standard logrotate: the writer keeps its fd (and may append a torn
+    line's second half) after the rename.  The tailer must wait one EOF
+    poll before declaring the old generation drained."""
+    path = str(tmp_path / "raw.jsonl")
+    buckets = make_series_buckets(4, seed=5)
+    line = _bucket_line(buckets[2])
+    writer = open(path, "wb")
+    writer.write(_bucket_line(buckets[0]) + _bucket_line(buckets[1]))
+    writer.write(line[:10])                    # torn mid-line
+    writer.flush()
+    tailer = BucketTailer(path)
+    assert len(tailer.poll()) == 2
+    os.rename(path, path + ".old")
+    save_raw_data_jsonl([buckets[3]], path)    # new generation
+    tailer.poll()                              # EOF 1: grace, no switch
+    writer.write(line[10:])                    # writer finishes late
+    writer.flush(); writer.close()
+    got = _drain(tailer)
+    assert {b.to_dict()["metrics"][0]["value"] for b in got} == \
+        {buckets[2].to_dict()["metrics"][0]["value"],
+         buckets[3].to_dict()["metrics"][0]["value"]}
+    assert tailer.dropped == 0                 # torn line was NOT mangled
+
+
+def test_tailer_releases_fd_after_unlink(tmp_path):
+    """An unlinked-and-never-recreated file must not pin its inode through
+    the held fd for the process lifetime."""
+    path = str(tmp_path / "raw.jsonl")
+    save_raw_data_jsonl(make_series_buckets(3), path)
+    tailer = BucketTailer(path)
+    assert len(tailer.poll()) == 3
+    os.unlink(path)
+    tailer.poll()                              # EOF 1: grace
+    tailer.poll()                              # EOF 2: fd released
+    assert tailer._f is None
+    tailer.close()
+
+
 # ---------------------------------------------------------------------------
 # Normalization-stat policy (module docstring: per-feature, monotone union)
 
@@ -251,7 +339,9 @@ def test_tailer_recovers_from_same_size_replacement(tmp_path):
     # producer restart: new file (new inode), larger than the old offset
     save_raw_data_jsonl(buckets[2:], str(tmp_path / "new.jsonl"))
     os.replace(str(tmp_path / "new.jsonl"), path)
-    got = tailer.poll()
+    # the switch takes one extra EOF poll (writer-keeps-fd grace); run()
+    # re-polls immediately while tailer.backlog is set, so drain like it
+    got = _drain(tailer)
     assert len(got) == 4 and tailer.dropped == 0
     assert got[0].to_dict() == buckets[2].to_dict()
 
