@@ -1,18 +1,20 @@
 """Pallas GRU kernel tuning experiments (diagnostic, TPU-only).
 
-Times forward-kernel variants at the flagship shape with honest readback
-sync, to pick the production configuration of ops/pallas_gru.py:
+Times recurrence variants at the flagship shape with honest readback sync,
+to pick the production configuration of ops/pallas_gru.py:
 
-- E_BLK sweep (experts per grid program): fewer grid programs = less
-  per-program pipeline overhead, more VMEM residency.
-- T_BLK (time steps per grid program): amortizes DMA/program overhead
-  across several sequential recurrence steps.
-- batched dot_general over the expert block vs a static Python unroll.
-- fused bidirectional: both directions stacked on the expert axis in ONE
-  kernel invocation (the backward direction's proj is pre-flipped), vs
-  two sequential kernel calls.
+- fused bidirectional (both directions stacked on the expert axis, ONE
+  kernel invocation, the backward direction's proj pre-flipped — the
+  production path since round 4) vs two sequential single-direction calls;
+- E_BLK (experts per grid program) × T_BLK (time steps per program) sweep
+  at the fused E=80 stacking;
+- f32 vs bf16 recurrence dots (weights+hidden cast to bf16 for the MXU,
+  f32 accumulate) — f32 matmul peak is ~1/4 of bf16 on v5e;
+- forward-only AND fwd+bwd (custom-VJP) timings: the backward kernel does
+  3 dots/step vs the forward's 1, so a tuning decision made on forward
+  times alone could pessimize training.
 
-Run: python benchmarks/kernel_tuning.py
+Run: python benchmarks/kernel_tuning.py [--out results.json]
 """
 
 from __future__ import annotations
@@ -29,10 +31,12 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 B, T, F, E, H = 32, 60, 512, 40, 128
+E2 = 2 * E                      # fused bidirectional stacking
 
 
-def make_fwd_call(e_blk_target: int, t_blk: int, batched_dot: bool,
-                  bf16_dot: bool = False):
+def make_fwd_call(e_blk_target: int, t_blk: int, bf16_dot: bool = False):
+    """A standalone forward-recurrence pallas_call with the given blocking,
+    mirroring ops/pallas_gru._fwd_call (time-OUTER, expert-INNER loop)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -45,50 +49,29 @@ def make_fwd_call(e_blk_target: int, t_blk: int, batched_dot: bool,
         def _init():
             h_scr[...] = h0_ref[...].astype(jnp.float32)
 
-        if batched_dot:
-            for tt in range(t_blk):
-                h = h_scr[...]                                # [EB, B, H]
-                w = w_ref[...].astype(jnp.float32)            # [EB, H, 3H]
-                gates_h = jax.lax.dot_general(
-                    h, w, (((2,), (1,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32,
-                ) + b_ref[...][:, None, :].astype(jnp.float32)
-                xproj = proj_ref[:, tt].astype(jnp.float32)   # [EB, B, 3H]
+        n_e = proj_ref.shape[0]
+        dot_t = jnp.bfloat16 if bf16_dot else jnp.float32
+        hs = [h_scr[i] for i in range(n_e)]
+        ws = [w_ref[i].astype(dot_t) for i in range(n_e)]
+        bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
+        for tt in range(t_blk):
+            for i in range(n_e):
+                gates_h = (
+                    jax.lax.dot_general(hs[i].astype(dot_t), ws[i],
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                    + bs[i]
+                )
+                xproj = proj_ref[i, tt].astype(jnp.float32)
                 xr, xz, xn = jnp.split(xproj, 3, axis=-1)
                 hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
                 r = jax.nn.sigmoid(xr + hr)
                 z = jax.nn.sigmoid(xz + hz)
                 n = jnp.tanh(xn + r * hn)
-                h_new = (1.0 - z) * n + z * h
-                h_scr[...] = h_new
-                out_ref[:, tt] = h_new.astype(out_ref.dtype)
-        else:
-            # Time-OUTER, expert-INNER: at each time step the e_blk expert
-            # matmuls are independent and can pipeline through the MXU;
-            # expert-outer would serialize each expert's full t_blk chain.
-            n_e = proj_ref.shape[0]
-            dot_t = jnp.bfloat16 if bf16_dot else jnp.float32
-            hs = [h_scr[i] for i in range(n_e)]
-            ws = [w_ref[i].astype(dot_t) for i in range(n_e)]
-            bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
-            for tt in range(t_blk):
-                for i in range(n_e):
-                    gates_h = (
-                        jax.lax.dot_general(hs[i].astype(dot_t), ws[i],
-                                            (((1,), (0,)), ((), ())),
-                                            preferred_element_type=jnp.float32)
-                        + bs[i]
-                    )
-                    xproj = proj_ref[i, tt].astype(jnp.float32)
-                    xr, xz, xn = jnp.split(xproj, 3, axis=-1)
-                    hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
-                    r = jax.nn.sigmoid(xr + hr)
-                    z = jax.nn.sigmoid(xz + hz)
-                    n = jnp.tanh(xn + r * hn)
-                    hs[i] = (1.0 - z) * n + z * hs[i]
-                    out_ref[i, tt] = hs[i].astype(out_ref.dtype)
-            for i in range(n_e):
-                h_scr[i] = hs[i]
+                hs[i] = (1.0 - z) * n + z * hs[i]
+                out_ref[i, tt] = hs[i].astype(out_ref.dtype)
+        for i in range(n_e):
+            h_scr[i] = hs[i]
 
     def call(proj, w_hh, b_hh, h0):
         e, t, b, g3 = proj.shape
@@ -124,47 +107,75 @@ def main():
 
     assert jax.devices()[0].platform == "tpu", "TPU-only experiment"
 
+    from deeprest_tpu.ops import pallas_gru
+
     rng = np.random.default_rng(0)
-    results = {}
+    results = {"shape": {"B": B, "T": T, "E": E, "H": H, "fused_E": E2}}
 
     def measure(fn, args, iters=50):
+        # Sync by summing the first output leaf: works for array outputs
+        # AND the 0-d loss of value_and_grad (indexing [..., 0] would not).
         out = fn(*args)
-        _ = float(jnp.sum(out[..., 0]))   # compile + readback sync
+        _ = float(jnp.sum(jax.tree.leaves(out)[0]))  # compile + readback sync
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)
-        _ = float(jnp.sum(out[..., 0]))
+        _ = float(jnp.sum(jax.tree.leaves(out)[0]))
         return (time.perf_counter() - t0) / iters * 1e3
 
-    # ---- single-direction variants --------------------------------------
-    proj = jnp.asarray(rng.standard_normal((E, T, B, 3 * H)), jnp.float32)
-    w_hh = jnp.asarray(rng.standard_normal((E, H, 3 * H)) * 0.05, jnp.float32)
-    b_hh = jnp.asarray(rng.standard_normal((E, 3 * H)) * 0.05, jnp.float32)
-    h0 = jnp.zeros((E, B, H), jnp.float32)
+    t_padded = pallas_gru.pad_time(T)
 
-    # reference output for correctness
-    from deeprest_tpu.ops import pallas_gru
-    ref = pallas_gru.gru_recurrence(proj, w_hh, b_hh, h0, False)
-    ref_np = np.asarray(ref)
+    def mk(e):
+        proj = jnp.asarray(rng.standard_normal((e, t_padded, B, 3 * H)),
+                           jnp.float32)
+        w_hh = jnp.asarray(rng.standard_normal((e, H, 3 * H)) * 0.05,
+                           jnp.float32)
+        b_hh = jnp.asarray(rng.standard_normal((e, 3 * H)) * 0.05, jnp.float32)
+        h0 = jnp.zeros((e, B, H), jnp.float32)
+        return proj, w_hh, b_hh, h0
 
-    results["current_E8_T1_unroll"] = measure(
-        lambda p, w, b, h: pallas_gru.gru_recurrence(p, w, b, h, False),
-        (proj, w_hh, b_hh, h0))
-    print("current", results["current_E8_T1_unroll"], flush=True)
+    args40, args80 = mk(E), mk(E2)
 
-    for e_blk, t_blk, bf16 in itertools.product((8,), (1, 2, 6, 12), (False, True)):
+    # Production path: forward and fwd+bwd through the custom VJP.
+    prod = jax.jit(functools.partial(pallas_gru.gru_recurrence,
+                                     interpret=False))
+    ref80 = np.asarray(prod(*args80))
+    results["prod_fwd_E40_ms"] = round(measure(prod, args40), 3)
+    results["prod_fwd_fusedE80_ms"] = round(measure(prod, args80), 3)
+
+    train_like = jax.jit(jax.value_and_grad(
+        lambda p, w, b, h: jnp.sum(
+            pallas_gru.gru_recurrence(p, w, b, h, False) ** 2),
+        argnums=(0, 1, 2, 3)))
+    results["prod_fwdbwd_E40_ms"] = round(measure(train_like, args40), 3)
+    results["prod_fwdbwd_fusedE80_ms"] = round(measure(train_like, args80), 3)
+    # two sequential E=40 calls ≈ the old unfused bidirectional cost
+    results["unfused_equiv_fwdbwd_ms"] = round(
+        2 * results["prod_fwdbwd_E40_ms"], 3)
+    print(json.dumps(results, indent=2), flush=True)
+
+    # Blocking sweep at the fused stacking.
+    for e_blk, t_blk, bf16 in itertools.product(
+            (8, 16, 20), (6, 10, 12), (False, True)):
+        if E2 % e_blk or t_padded % t_blk:
+            continue
         key = f"E{e_blk}_T{t_blk}_{'bf16' if bf16 else 'f32'}"
         try:
-            call = jax.jit(make_fwd_call(e_blk, t_blk, False, bf16_dot=bf16))
-            ms = measure(call, (proj, w_hh, b_hh, h0))
-            err = float(np.max(np.abs(np.asarray(call(proj, w_hh, b_hh, h0))
-                                      - ref_np)))
+            call = jax.jit(make_fwd_call(e_blk, t_blk, bf16_dot=bf16))
+            ms = measure(call, args80)
+            err = float(np.max(np.abs(np.asarray(call(*args80)) - ref80)))
             results[key] = {"ms": round(ms, 3), "max_err": err}
         except Exception as exc:
             results[key] = {"error": str(exc)[:160]}
         print(key, results[key], flush=True)
 
+    out_path = None
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
     print(json.dumps(results, indent=2, default=str))
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(results, f, indent=2, default=str)
 
 
 if __name__ == "__main__":

@@ -119,6 +119,50 @@ def cmd_featurize(args) -> int:
     return 0
 
 
+def cmd_ingest(args) -> int:
+    """Jaeger/OTLP trace dumps + Prometheus range dumps → raw JSONL.
+
+    The adapter for pointing the estimator at an EXISTING instrumented
+    cluster (reference input contract: resource-estimation/README.md:29-63)
+    instead of this framework's own collector."""
+    from deeprest_tpu.data.ingest import MetricRule, ingest_files
+    from deeprest_tpu.data.schema import save_raw_data_jsonl
+
+    resource_map = None
+    if args.metric_map:
+        resource_map = {}
+        for spec in args.metric_map:
+            parts = spec.split(":")
+            if len(parts) not in (2, 3) or not all(parts):
+                print(f"bad --metric-map entry {spec!r} "
+                      "(want prom_metric:resource[:gauge|counter])")
+                return 2
+            prom_name, resource = parts[0], parts[1]
+            mode = parts[2] if len(parts) == 3 else "gauge"
+            if mode not in ("gauge", "counter"):
+                # A typo'd mode must not silently average a cumulative
+                # counter into monotonically exploding values.
+                print(f"bad --metric-map mode {mode!r} in {spec!r} "
+                      "(must be 'gauge' or 'counter')")
+                return 2
+            resource_map[prom_name] = MetricRule(resource, mode)
+    buckets = ingest_files(args.traces, args.prom or [], args.bucket_seconds,
+                           resource_map=resource_map)
+    if not buckets:
+        print("ingest: no buckets produced (empty dumps or disjoint ranges)")
+        return 1
+    save_raw_data_jsonl(buckets, args.out)
+    keys = sorted({(m.component, m.resource) for m in buckets[0].metrics})
+    print(json.dumps({
+        "out": args.out,
+        "buckets": len(buckets),
+        "traces": sum(len(b.traces) for b in buckets),
+        "metric_keys": len(keys),
+        "components": sorted({c for c, _ in keys}),
+    }))
+    return 0
+
+
 def cmd_train(args) -> int:
     from deeprest_tpu.config import Config, MeshConfig, ModelConfig, TrainConfig
     from deeprest_tpu.models.baselines import baseline_predictions
@@ -518,6 +562,23 @@ def build_parser() -> argparse.ArgumentParser:
     _add_input_args(p, features_ok=False)
     p.add_argument("--out", default="input.npz")
     p.set_defaults(fn=cmd_featurize)
+
+    p = sub.add_parser(
+        "ingest",
+        help="Jaeger/OTLP + Prometheus dumps → raw corpus JSONL")
+    p.add_argument("--traces", nargs="+", required=True,
+                   help="Jaeger query-API or OTLP/JSON trace dump files")
+    p.add_argument("--prom", nargs="*", default=[],
+                   help="Prometheus query_range JSON dump files")
+    p.add_argument("--bucket-seconds", type=float, default=5.0,
+                   help="discretization window (= the cluster's scrape "
+                        "interval; the reference scrapes at 5s)")
+    p.add_argument("--metric-map", nargs="*", default=None,
+                   metavar="PROM_METRIC:RESOURCE[:MODE]",
+                   help="override the cadvisor-style default metric map "
+                        "(mode: gauge|counter)")
+    p.add_argument("--out", default="raw_data.jsonl")
+    p.set_defaults(fn=cmd_ingest)
 
     p = sub.add_parser("train", help="train + eval vs both baselines")
     _add_input_args(p)

@@ -56,6 +56,9 @@ class TrainConfig:
     window_size: int = 60         # sliding-window length (time steps)
     eval_stride: int = 60         # test windows sampled every `stride` steps
     eval_max_cycles: int = 9      # cap on evaluated test windows per epoch
+    eval_batch_size: int = 64     # eval windows per device batch (pages the
+                                  # eval like predict(); one giant batch
+                                  # OOMs at wide F × many windows)
     seed: int = 0
     checkpoint_dir: str | None = None
     checkpoint_every_epochs: int = 10

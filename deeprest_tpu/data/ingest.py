@@ -1,0 +1,358 @@
+"""Ingestion adapters: real telemetry systems → the raw-data contract.
+
+The reference's input contract is "Jaeger span trees + Prometheus metrics
+from an instrumented cluster" (reference: resource-estimation/README.md:29-63
+— "the tracing tool (e.g., Jaeger)" / "the monitoring tool (e.g.,
+Prometheus)"; tracer wiring social-network-source/src/tracing.h:52-61;
+Jaeger deployment social-network-deploy/k8s-yaml/tracing/run.yaml; scrape
+configs minikube-openebs/monitor-openebs-pg.yaml:38-173), but its repo ships
+no converter — the pickle appears fully formed.  This module is that
+converter: it turns
+
+- Jaeger query-API JSON (``GET /api/traces?...`` → ``{"data": [...]}``),
+- OTLP/JSON trace exports (``{"resourceSpans": [...]}``), and
+- Prometheus range-query JSON (``/api/v1/query_range`` → ``resultType:
+  "matrix"``)
+
+into :class:`~deeprest_tpu.data.schema.Bucket` lists that featurize
+identically to the framework's own collector JSONL, so the estimator can be
+pointed at ANY instrumented cluster with zero custom collection code.
+
+Discretization follows the reference: the bucket width is the monitoring
+scrape interval ("a window size ... defined as the scrape interval in the
+resource monitoring tool", README.md:29; the reference cluster scrapes at
+5 s, monitor-openebs-pg.yaml:38), traces land in the bucket of their root
+span's start time, and counter-style metrics contribute per-bucket
+increases while gauges contribute per-bucket means.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Iterable, Mapping, Sequence
+
+from deeprest_tpu.data.schema import Bucket, MetricSample, Span
+
+# ---------------------------------------------------------------------------
+# shared span-tree assembly
+# ---------------------------------------------------------------------------
+
+
+def _assemble_trees(
+    records: Sequence[tuple[str, str | None, float, str, str]],
+) -> list[tuple[float, Span]]:
+    """Link ``(span_id, parent_id, start_seconds, component, operation)``
+    records into rooted trees.
+
+    Shared by the Jaeger and OTLP adapters (same algorithm, different wire
+    fields): children are ordered by start time — the invocation ordering
+    the span tree encodes (reference: resource-estimation/README.md:49-55)
+    — and a span whose parent is absent from the dump becomes a root
+    (partial captures must surface, not vanish).  Returns (root start
+    seconds, tree) in root start order.
+    """
+    nodes: dict[str, Span] = {}
+    start: dict[str, float] = {}
+    parent: dict[str, str | None] = {}
+    for sid, pid, ts, component, operation in records:
+        nodes[sid] = Span(component=component, operation=operation)
+        start[sid] = ts
+        parent[sid] = pid
+    pending: dict[str, list[tuple[float, Span]]] = {}
+    for sid, node in nodes.items():
+        pid = parent[sid]
+        if pid is not None and pid in nodes:
+            pending.setdefault(pid, []).append((start[sid], node))
+    for pid, kids in pending.items():
+        nodes[pid].children = [c for _, c in sorted(kids, key=lambda p: p[0])]
+    roots = [sid for sid in nodes
+             if parent[sid] is None or parent[sid] not in nodes]
+    return [(start[sid], nodes[sid])
+            for sid in sorted(roots, key=lambda s: start[s])]
+
+
+# ---------------------------------------------------------------------------
+# Jaeger query-API JSON → (root start-time, span tree)
+# ---------------------------------------------------------------------------
+
+
+def jaeger_traces(payload: Mapping[str, Any]) -> list[tuple[float, Span]]:
+    """Convert a Jaeger query-API response into rooted span trees.
+
+    Accepts the ``{"data": [trace, ...]}`` envelope or a bare trace list.
+    Each Jaeger trace contributes one (start_time_seconds, tree) per root
+    span (spans with no CHILD_OF reference, or whose parent is missing
+    from the dump — Jaeger emits such orphans for partial captures).
+    """
+    traces = payload.get("data", payload) if isinstance(payload, Mapping) \
+        else payload
+    out: list[tuple[float, Span]] = []
+    for trace in traces:
+        procs = {
+            pid: (proc.get("serviceName") or pid)
+            for pid, proc in (trace.get("processes") or {}).items()
+        }
+        records = []
+        for s in trace.get("spans") or []:
+            pid = None
+            for ref in s.get("references") or []:
+                if ref.get("refType") == "CHILD_OF":
+                    pid = ref.get("spanID")
+                    break
+            records.append((
+                s["spanID"], pid, int(s.get("startTime", 0)) / 1e6,
+                str(procs.get(s.get("processID"), s.get("processID", "?"))),
+                str(s.get("operationName", "?")),
+            ))
+        out.extend(_assemble_trees(records))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OTLP/JSON trace export → (root start-time, span tree)
+# ---------------------------------------------------------------------------
+
+
+def otlp_traces(payload: Mapping[str, Any]) -> list[tuple[float, Span]]:
+    """Convert an OTLP/JSON trace export (``{"resourceSpans": [...]}``)
+    into rooted span trees.  The component is the resource's
+    ``service.name`` attribute — the same identity the reference's tracer
+    registers per service (reference: social-network-source/src/
+    tracing.h:52-61).  Spans are linked by (traceId, parentSpanId) across
+    resource boundaries, so a cross-service trace assembles into one tree.
+    """
+    records: list[dict] = []
+    for rs in payload.get("resourceSpans") or []:
+        service = "?"
+        for attr in ((rs.get("resource") or {}).get("attributes") or []):
+            if attr.get("key") == "service.name":
+                service = str((attr.get("value") or {}).get("stringValue",
+                                                            service))
+        for ss in rs.get("scopeSpans") or rs.get("instrumentationLibrarySpans") or []:
+            for s in ss.get("spans") or []:
+                records.append({
+                    "trace": s.get("traceId"),
+                    "id": s.get("spanId"),
+                    "parent": s.get("parentSpanId") or None,
+                    "service": service,
+                    "op": str(s.get("name", "?")),
+                    "start_ns": int(s.get("startTimeUnixNano", 0)),
+                })
+    by_trace: dict[str, list[dict]] = {}
+    for r in records:
+        by_trace.setdefault(r["trace"], []).append(r)
+    out: list[tuple[float, Span]] = []
+    for spans in by_trace.values():
+        out.extend(_assemble_trees([
+            (r["id"], r["parent"], r["start_ns"] / 1e9, r["service"], r["op"])
+            for r in spans
+        ]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus range-query JSON → (timestamp, component, resource, value)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricRule:
+    """How one Prometheus metric becomes a raw-data resource series.
+
+    ``mode='gauge'``: the bucket value is the mean of samples in the
+    window (memory bytes, fs usage).  ``mode='counter'``: the bucket value
+    is the within-bucket increase of a cumulative counter (cpu seconds,
+    write counts), reset-tolerant (a decrease restarts from the new
+    value).  These are the two shapes every metric in the reference's
+    scrape set has (cadvisor + OpenEBS volume exporters,
+    minikube-openebs/monitor-openebs-pg.yaml:60-173).
+    """
+
+    resource: str
+    mode: str = "gauge"  # 'gauge' | 'counter'
+
+
+# cadvisor-style defaults covering the reference's five resources
+# (cpu / memory / write-iops / write-throughput / usage — SURVEY.md §L2).
+DEFAULT_RESOURCE_MAP: dict[str, MetricRule] = {
+    "container_cpu_usage_seconds_total": MetricRule("cpu", "counter"),
+    "container_memory_working_set_bytes": MetricRule("memory", "gauge"),
+    "container_fs_writes_total": MetricRule("wiops", "counter"),
+    "container_fs_writes_bytes_total": MetricRule("wtp", "counter"),
+    "container_fs_usage_bytes": MetricRule("usage", "gauge"),
+}
+
+# Component-identity labels, first match wins.  kubernetes_pod_name is the
+# reference's own relabel target (monitor-openebs-pg.yaml:55-57,142-143).
+COMPONENT_LABELS = ("kubernetes_pod_name", "pod", "container_label_io_kubernetes_pod_name",
+                    "container", "instance", "job")
+
+
+def prometheus_series(
+    payload: Mapping[str, Any],
+    resource_map: Mapping[str, MetricRule] | None = None,
+    component_labels: Sequence[str] = COMPONENT_LABELS,
+) -> list[tuple[float, str, str, float, str]]:
+    """Flatten a ``query_range`` matrix response into
+    ``(ts_seconds, component, resource, value, mode)`` samples.
+
+    Series whose ``__name__`` has no entry in ``resource_map`` are skipped
+    (a range query scoped to one metric has no such series; a federated
+    dump may).  The component is the first present label from
+    ``component_labels``.
+    """
+    rmap = DEFAULT_RESOURCE_MAP if resource_map is None else resource_map
+    data = payload.get("data", payload)
+    out: list[tuple[float, str, str, float, str]] = []
+    for series in data.get("result") or []:
+        labels = series.get("metric") or {}
+        rule = rmap.get(labels.get("__name__", ""))
+        if rule is None:
+            continue
+        component = next((labels[l] for l in component_labels if l in labels),
+                        None)
+        if component is None:
+            continue
+        for ts, val in series.get("values") or ([series["value"]]
+                                                if "value" in series else []):
+            try:
+                v = float(val)
+            except (TypeError, ValueError):
+                continue
+            if math.isnan(v):
+                continue
+            out.append((float(ts), str(component), rule.resource, v, rule.mode))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# discretization onto the common bucket timeline
+# ---------------------------------------------------------------------------
+
+
+def bucketize(
+    traces: Iterable[tuple[float, Span]],
+    samples: Iterable[tuple[float, str, str, float, str]],
+    bucket_s: float,
+    t0: float | None = None,
+    t1: float | None = None,
+) -> list[Bucket]:
+    """Discretize traces + metric samples into the ordered bucket list the
+    estimator consumes (reference: resource-estimation/README.md:29-34 —
+    one item per scrape window).
+
+    Every bucket carries the full (component, resource) keyset observed
+    anywhere in the range, zero-filled when silent, so the metric-series
+    matrix is rectangular — the property featurization requires.
+    """
+    traces = list(traces)
+    samples = list(samples)
+    if bucket_s <= 0:
+        raise ValueError(f"bucket_s must be positive, got {bucket_s}")
+    times = [t for t, _ in traces] + [s[0] for s in samples]
+    if not times:
+        return []
+    lo = min(times) if t0 is None else t0
+    hi = max(times) if t1 is None else t1
+    lo = math.floor(lo / bucket_s) * bucket_s
+    n = max(1, int(math.ceil((hi - lo) / bucket_s + 1e-9)) or 1)
+    if hi >= lo + n * bucket_s:
+        n += 1
+
+    def idx(ts: float) -> int | None:
+        i = int((ts - lo) // bucket_s)
+        return i if 0 <= i < n else None
+
+    trace_buckets: list[list[Span]] = [[] for _ in range(n)]
+    for ts, root in traces:
+        i = idx(ts)
+        if i is not None:
+            trace_buckets[i].append(root)
+
+    # (component, resource) → per-bucket accumulators
+    gauge_sum: dict[tuple[str, str], list[float]] = {}
+    gauge_cnt: dict[tuple[str, str], list[int]] = {}
+    counter_vals: dict[tuple[str, str], list[list[tuple[float, float]]]] = {}
+    modes: dict[tuple[str, str], str] = {}
+    for ts, comp, res, val, mode in samples:
+        i = idx(ts)
+        if i is None:
+            continue
+        key = (comp, res)
+        modes[key] = mode
+        if mode == "counter":
+            counter_vals.setdefault(key, [[] for _ in range(n)])[i].append(
+                (ts, val))
+        else:
+            gauge_sum.setdefault(key, [0.0] * n)[i] += val
+            gauge_cnt.setdefault(key, [0] * n)[i] += 1
+
+    keys = sorted(modes)
+    values: dict[tuple[str, str], list[float]] = {}
+    for key in keys:
+        if modes[key] == "counter":
+            per = counter_vals[key]
+            vals = [0.0] * n
+            prev_last: float | None = None
+            for i in range(n):
+                pts = sorted(per[i])
+                inc = 0.0
+                last = prev_last
+                for _, v in pts:
+                    if last is None:
+                        last = v
+                        continue
+                    # reset-tolerant increase (counter restarted below its
+                    # previous value): count growth from the new base.
+                    inc += v - last if v >= last else v
+                    last = v
+                vals[i] = inc
+                prev_last = last if last is not None else prev_last
+            values[key] = vals
+        else:
+            values[key] = [
+                gauge_sum[key][i] / gauge_cnt[key][i]
+                if gauge_cnt[key][i] else 0.0
+                for i in range(n)
+            ]
+
+    buckets = []
+    for i in range(n):
+        metrics = [MetricSample(component=c, resource=r,
+                                value=values[(c, r)][i])
+                   for c, r in keys]
+        buckets.append(Bucket(metrics=metrics, traces=trace_buckets[i]))
+    return buckets
+
+
+# ---------------------------------------------------------------------------
+# file-level convenience (the CLI's ingest surface)
+# ---------------------------------------------------------------------------
+
+
+def ingest_files(
+    trace_paths: Sequence[str],
+    prom_paths: Sequence[str],
+    bucket_s: float,
+    resource_map: Mapping[str, MetricRule] | None = None,
+) -> list[Bucket]:
+    """Load Jaeger/OTLP trace dumps + Prometheus range dumps and produce
+    the ordered bucket list.  Format auto-detection: a payload with
+    ``resourceSpans`` is OTLP, otherwise Jaeger query JSON; metric files
+    must be query_range responses."""
+    traces: list[tuple[float, Span]] = []
+    for path in trace_paths:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+        if isinstance(payload, Mapping) and "resourceSpans" in payload:
+            traces.extend(otlp_traces(payload))
+        else:
+            traces.extend(jaeger_traces(payload))
+    samples: list[tuple[float, str, str, float, str]] = []
+    for path in prom_paths:
+        with open(path, encoding="utf-8") as f:
+            samples.extend(prometheus_series(json.load(f),
+                                             resource_map=resource_map))
+    return bucketize(traces, samples, bucket_s)

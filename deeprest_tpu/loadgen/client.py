@@ -119,3 +119,32 @@ def register_with_collector(host: str, port: int, component: str, pid: int,
     payload = json.dumps({"register": component, "pid": pid}).encode()
     with socket.create_connection((host, port), timeout=timeout) as s:
         s.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def chaos_burn(host: str, port: int, seconds: float,
+               timeout: float = 5.0) -> dict:
+    """Fire the ChaosBurn fault injection at a service's RPC port: the
+    service forks an UNREGISTERED cpu-burning child (simulated compromise;
+    requires the cluster to run with DEEPREST_CHAOS=1).  Returns the
+    injected child's pid — the collector must attribute its CPU to the
+    victim with no cooperation from either."""
+    req = json.dumps({"m": "ChaosBurn", "t": [0, 0, False],
+                      "a": {"seconds": seconds}}).encode()
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(struct.pack(">I", len(req)) + req)
+        hdr = _recv_exact(s, 4)
+        (length,) = struct.unpack(">I", hdr)
+        resp = json.loads(_recv_exact(s, length))
+    if not resp.get("ok", False):
+        raise RuntimeError(f"ChaosBurn failed: {resp.get('e')}")
+    return resp.get("r", {})
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf += chunk
+    return buf

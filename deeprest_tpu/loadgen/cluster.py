@@ -73,7 +73,12 @@ class SnsCluster:
 
     def __init__(self, out_path: str, interval_ms: int = 5000,
                  grace_ms: int = 1000, verbose: bool = False,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None, chaos: bool = False):
+        # chaos=True arms the ChaosBurn fault-injection RPC in every
+        # service (DEEPREST_CHAOS=1): a service can be told to fork an
+        # unregistered cpu-burner child — the non-cooperative cryptojack
+        # scenario (SURVEY.md §5.3).
+        self.chaos = chaos
         # Collector /metrics + dashboard port, allocated at start()
         # (the reference's Prometheus scrape surface,
         # monitor-openebs-pg.yaml:38-173).
@@ -153,7 +158,12 @@ class SnsCluster:
         if self.verbose:
             cmd.append("--verbose")
         out = None if self.verbose else subprocess.DEVNULL
-        self._procs[component] = subprocess.Popen(cmd, stdout=out, stderr=out)
+        env = None
+        if self.chaos:
+            env = dict(os.environ)
+            env["DEEPREST_CHAOS"] = "1"
+        self._procs[component] = subprocess.Popen(cmd, stdout=out, stderr=out,
+                                                  env=env)
 
     def restart(self, component: str, timeout: float = 10.0,
                 graceful: bool = False) -> None:

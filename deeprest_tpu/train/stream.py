@@ -111,6 +111,11 @@ class BucketTailer:
     # run loop drains the backlog across successive polls, refreshing along
     # the way.
     MAX_POLL_BYTES = 64 << 20
+    # Wall-clock grace between a rotated-away generation's first observed
+    # EOF and the switch away from it: a rename-rotation writer keeps its
+    # fd (and may still flush a torn line's remainder) until it reopens
+    # the path.
+    GRACE_S = 0.25
 
     def __init__(self, path: str, max_poll_bytes: int | None = None):
         self.path = path
@@ -129,9 +134,12 @@ class BucketTailer:
         # still lose data (its loss is unquantifiable: the overwritten tail
         # was never observable).
         self.truncated_events = 0
-        # Consecutive polls that found the current (rotated-away)
-        # generation at EOF — the switch grace counter (see poll()).
-        self._eof_polls = 0
+        # Wall-clock instant the current (rotated-away or unlinked)
+        # generation was first seen at EOF — the switch grace anchor (see
+        # poll()).  Wall-clock, not a poll count: callers may re-poll
+        # microseconds apart (run() skips its sleep after a non-empty
+        # poll), which would make a counted grace effectively zero.
+        self._eof_since: float | None = None
 
     def close(self) -> None:
         """Release every held file handle.  For shutdown: a reused tailer
@@ -210,7 +218,7 @@ class BucketTailer:
                 self._carry = b""
             chunk = self._f.read(self.max_poll_bytes)
             if chunk:
-                self._eof_polls = 0
+                self._eof_since = None
                 out.extend(self._parse(chunk))
             fst = os.fstat(self._f.fileno())
             pos = self._f.tell()
@@ -247,37 +255,37 @@ class BucketTailer:
                 try:
                     os.stat(self.path)
                 except OSError:
-                    self._eof_polls += 1
-                    if self._eof_polls >= 2:
+                    now = time.monotonic()
+                    if self._eof_since is None:
+                        self._eof_since = now
+                    elif now - self._eof_since >= self.GRACE_S:
                         if self._carry:
                             out.extend(self._parse(b"\n"))
                         self._f.close()
                         self._f = None
-                        self._eof_polls = 0
+                        self._eof_since = None
                 self.backlog = False
                 return out
             # Drained a rotated-away generation — but a momentary EOF is
             # not proof the producer is done: a standard rename-rotation
-            # writer keeps its fd (and may still append) until it reopens
-            # the path.  Wait for EOF on a second consecutive poll before
-            # switching, so the producer gets a poll interval of grace to
-            # finish its last writes; only then is an unterminated final
-            # line treated as complete and flushed.
-            self._eof_polls += 1
-            if self._eof_polls < 2:
-                # backlog stays False for the grace poll: run() re-polls
-                # IMMEDIATELY while backlog is set, which would make the
-                # grace effectively zero — the producer gets a real poll
-                # interval (run()'s sleep) to finish its last writes, at
-                # the cost of delaying the queued generation by that
-                # interval.
+            # writer keeps its fd (and may still flush a torn line's
+            # remainder) until it reopens the path.  Hold the fd for
+            # GRACE_S of WALL CLOCK after the first EOF sighting before
+            # switching; only then is an unterminated final line treated
+            # as complete and flushed.  (backlog stays False meanwhile so
+            # a sleeping caller isn't spun; an eager caller re-polling
+            # instantly still cannot shrink the wall-clock grace.)
+            now = time.monotonic()
+            if self._eof_since is None:
+                self._eof_since = now
+            if now - self._eof_since < self.GRACE_S:
                 self.backlog = False
                 return out
             if self._carry:
                 out.extend(self._parse(b"\n"))
             self._f.close()
             self._f = None
-            self._eof_polls = 0
+            self._eof_since = None
             print(f"stream: {self.path} rotation drain complete (zero "
                   f"loss); switching to the next generation "
                   f"({len(self._pending)} queued)")

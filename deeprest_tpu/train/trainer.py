@@ -192,12 +192,23 @@ class Trainer:
                                   cfg.eval_max_cycles)
         if len(idx) == 0:
             raise ValueError("no eval windows: test split shorter than stride")
-        # Replicated feed: the (≤ eval_max_cycles) eval windows need not
-        # divide the data axis, and every process holds the same windows.
-        xb = feed_replicated(self.mesh, bundle.x_test[idx])
-        yb = feed_replicated(self.mesh, bundle.y_test[idx])
-        preds, loss = self._eval_step(state.params, xb, yb)
-        preds = gather_to_host(preds)
+        # Batched, replicated feed (the windows need not divide the data
+        # axis, and every process holds the same windows).  One giant batch
+        # would OOM at a large ``eval_max_cycles`` on a wide model (the
+        # F=10240 flagship at 500 windows), so eval pages through the
+        # windows like ``predict`` does; the loss is the window-weighted
+        # mean of the per-chunk pinball means.
+        bs = cfg.eval_batch_size
+        preds_chunks, loss_sum = [], 0.0
+        for lo in range(0, len(idx), bs):
+            sel = idx[lo:lo + bs]
+            xb = feed_replicated(self.mesh, bundle.x_test[sel])
+            yb = feed_replicated(self.mesh, bundle.y_test[sel])
+            p, l = self._eval_step(state.params, xb, yb)
+            preds_chunks.append(np.asarray(gather_to_host(p)))
+            loss_sum += float(l) * len(sel)
+        preds = np.concatenate(preds_chunks, axis=0)
+        loss = loss_sum / len(idx)
 
         # Floor the *normalized* median prediction at 1e-6 before
         # de-normalizing — the reference's clamp order (estimate.py:100-103);
@@ -207,7 +218,7 @@ class Trainer:
         preds_denorm = bundle.denorm_targets(
             np.maximum(np.asarray(preds[..., med]), 1e-6)
         )
-        labels_denorm = bundle.denorm_targets(np.asarray(yb))
+        labels_denorm = bundle.denorm_targets(np.asarray(bundle.y_test[idx]))
 
         errors = {"deepr": np.abs(preds_denorm - labels_denorm)}
         if baseline_preds:

@@ -52,9 +52,12 @@ class Collector {
   ClusterConfig* config_;
   CollectorOptions options_;
   std::mutex mu_;
-  std::map<std::string, int> watched_;  // component -> pid
+  std::map<std::string, int> watched_;  // component -> registered root pid
   std::unordered_map<uint64_t, PendingTrace> pending_;
-  std::map<std::string, ProcSample> last_samples_;
+  // component -> (pid -> last cumulative sample) over the registered pid's
+  // whole process tree: per-pid deltas make unregistered children
+  // (non-cooperative processes) attributable (see CutBucket).
+  std::map<std::string, std::map<int, ProcSample>> last_samples_;
   // live observability state (all guarded by mu_)
   std::map<std::pair<std::string, std::string>, double> latest_;
   uint64_t spans_ingested_ = 0;

@@ -7,6 +7,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -328,7 +329,40 @@ int AcceptWithTimeout(int listen_fd, int timeout_ms) {
 // RpcServer
 
 RpcServer::RpcServer(std::string component, int port)
-    : component_(std::move(component)), port_(port) {}
+    : component_(std::move(component)), port_(port) {
+  // Fault-injection surface (SURVEY.md §5.3), gated behind DEEPREST_CHAOS:
+  // "ChaosBurn" simulates a compromised service by forking an UNREGISTERED
+  // cpu-burning child inside this service's process tree.  The collector
+  // must attribute that child to this component without any registration
+  // (non-cooperative attribution, collector.cpp ProcessTree) — the threat
+  // model cryptojack detection exists for: a real miner does not register.
+  if (std::getenv("DEEPREST_CHAOS") != nullptr) {
+    Register("ChaosBurn", [](const TraceContext&, const Json& a) {
+      double seconds = a.has("seconds") ? a["seconds"].as_double() : 2.0;
+      int status;
+      while (::waitpid(-1, &status, WNOHANG) > 0) {
+      }  // reap finished chaos children (snsd spawns no other children)
+      pid_t child = ::fork();
+      if (child < 0)  // report honestly: the caller's injection did NOT run
+        throw std::runtime_error("ChaosBurn: fork failed");
+      if (child == 0) {
+        // Post-fork in a threaded process: pure compute + _exit only.
+        auto end = std::chrono::steady_clock::now() +
+                   std::chrono::duration<double>(seconds);
+        volatile uint64_t x = 0x9e3779b97f4a7c15ull;
+        while (std::chrono::steady_clock::now() < end) {
+          for (int i = 0; i < 100000; ++i)
+            x = x * 6364136223846793005ull + 1442695040888963407ull;
+        }
+        ::_exit(0);
+      }
+      JsonObject o;
+      o["pid"] = Json(int64_t{child});
+      o["seconds"] = Json(seconds);
+      return Json(std::move(o));
+    });
+  }
+}
 
 void RpcServer::Register(const std::string& method, RpcHandler handler) {
   handlers_[method] = std::move(handler);

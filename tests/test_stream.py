@@ -99,17 +99,25 @@ def test_tailer_handles_missing_then_created_file(tmp_path):
     assert len(tailer.poll()) == 3
 
 
-def _drain(tailer, max_polls=2000):
-    """Poll until quiescent.  Two consecutive idle polls are required:
-    the grace poll before a generation switch is idle-with-backlog-False
-    by design (run() covers it with its sleep interval)."""
-    got, idle = [], 0
-    for _ in range(max_polls):
+def _drain(tailer, timeout_s=30.0):
+    """Poll until quiescent, like run() does (sleeping between idle
+    polls).  Quiescent = idle for longer than the wall-clock rotation
+    grace, so a switch pending behind GRACE_S still happens in here."""
+    got = []
+    idle_since = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         batch = tailer.poll()
         got.extend(batch)
-        idle = idle + 1 if not batch and not tailer.backlog else 0
-        if idle == 2:
+        if batch or tailer.backlog:
+            idle_since = None
+            continue
+        now = time.monotonic()
+        if idle_since is None:
+            idle_since = now
+        elif now - idle_since > BucketTailer.GRACE_S + 0.2:
             return got
+        time.sleep(0.02)
     raise AssertionError("tailer never drained")
 
 
@@ -181,8 +189,9 @@ def test_tailer_releases_fd_after_unlink(tmp_path):
     tailer = BucketTailer(path)
     assert len(tailer.poll()) == 3
     os.unlink(path)
-    tailer.poll()                              # EOF 1: grace
-    tailer.poll()                              # EOF 2: fd released
+    tailer.poll()                              # EOF seen: grace starts
+    time.sleep(BucketTailer.GRACE_S + 0.05)
+    tailer.poll()                              # grace elapsed: fd released
     assert tailer._f is None
     tailer.close()
 

@@ -76,6 +76,27 @@ def test_eval_with_baselines(bundle):
             [stats["median"], stats["p95"], stats["max"]], 1.0, rtol=1e-5)
 
 
+def test_eval_batching_matches_single_batch(bundle):
+    """Paged eval (eval_batch_size < #windows) must reproduce the one-shot
+    loss and MAE report exactly: chunking is a memory optimization, not a
+    semantic change."""
+    import dataclasses as _dc
+
+    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    state = trainer.init_state(bundle.x_train)
+    loss_one, rep_one = trainer.evaluate(state, bundle)
+
+    paged_cfg = SMALL.replace(
+        train=_dc.replace(SMALL.train, eval_batch_size=1))
+    paged = Trainer(paged_cfg, bundle.feature_dim, bundle.metric_names)
+    loss_paged, rep_paged = paged.evaluate(state, bundle)
+    assert loss_paged == pytest.approx(loss_one, rel=1e-6)
+    for metric in bundle.metric_names:
+        for k in ("median", "p95", "p99", "max"):
+            assert rep_paged[metric]["deepr"][k] == pytest.approx(
+                rep_one[metric]["deepr"][k], rel=1e-6)
+
+
 def test_padded_batch_loss_exact():
     """Zero-weight padding must reproduce the unpadded batch mean."""
     rng = np.random.default_rng(0)
