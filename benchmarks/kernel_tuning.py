@@ -102,6 +102,15 @@ def make_fwd_call(e_blk_target: int, t_blk: int, bf16_dot: bool = False):
 
 
 def main():
+    # Parse argv BEFORE the multi-minute sweep so a malformed --out fails
+    # at startup, not after all the work is done.
+    out_path = None
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv):
+            sys.exit("--out requires a path argument")
+        out_path = sys.argv[i + 1]
+
     import jax
     import jax.numpy as jnp
 
@@ -169,9 +178,6 @@ def main():
             results[key] = {"error": str(exc)[:160]}
         print(key, results[key], flush=True)
 
-    out_path = None
-    if "--out" in sys.argv:
-        out_path = sys.argv[sys.argv.index("--out") + 1]
     print(json.dumps(results, indent=2, default=str))
     if out_path:
         with open(out_path, "w", encoding="utf-8") as f:

@@ -129,7 +129,9 @@ def cmd_ingest(args) -> int:
     from deeprest_tpu.data.schema import save_raw_data_jsonl
 
     resource_map = None
-    if args.metric_map:
+    if args.metric_map is not None:
+        # An explicitly-empty map is honored (ingest traces only, suppress
+        # all metrics) rather than silently falling back to the default.
         resource_map = {}
         for spec in args.metric_map:
             parts = spec.split(":")

@@ -194,18 +194,23 @@ def prometheus_series(
     payload: Mapping[str, Any],
     resource_map: Mapping[str, MetricRule] | None = None,
     component_labels: Sequence[str] = COMPONENT_LABELS,
-) -> list[tuple[float, str, str, float, str]]:
+) -> list[tuple[float, str, str, float, str, str]]:
     """Flatten a ``query_range`` matrix response into
-    ``(ts_seconds, component, resource, value, mode)`` samples.
+    ``(ts_seconds, component, resource, value, mode, series_id)`` samples.
 
     Series whose ``__name__`` has no entry in ``resource_map`` are skipped
     (a range query scoped to one metric has no such series; a federated
     dump may).  The component is the first present label from
-    ``component_labels``.
+    ``component_labels``.  ``series_id`` is the full label set: several
+    Prometheus series can share one (component, resource) key — a
+    multi-container pod has one cumulative cpu counter PER container —
+    and counter increases are only meaningful within ONE series
+    (interleaving two counters looks like resets and giant jumps), so
+    bucketize aggregates per series first, then sums across series.
     """
     rmap = DEFAULT_RESOURCE_MAP if resource_map is None else resource_map
     data = payload.get("data", payload)
-    out: list[tuple[float, str, str, float, str]] = []
+    out: list[tuple[float, str, str, float, str, str]] = []
     for series in data.get("result") or []:
         labels = series.get("metric") or {}
         rule = rmap.get(labels.get("__name__", ""))
@@ -215,6 +220,7 @@ def prometheus_series(
                         None)
         if component is None:
             continue
+        sid = json.dumps(sorted(labels.items()))
         for ts, val in series.get("values") or ([series["value"]]
                                                 if "value" in series else []):
             try:
@@ -223,7 +229,8 @@ def prometheus_series(
                 continue
             if math.isnan(v):
                 continue
-            out.append((float(ts), str(component), rule.resource, v, rule.mode))
+            out.append((float(ts), str(component), rule.resource, v,
+                        rule.mode, sid))
     return out
 
 
@@ -234,7 +241,8 @@ def prometheus_series(
 
 def bucketize(
     traces: Iterable[tuple[float, Span]],
-    samples: Iterable[tuple[float, str, str, float, str]],
+    samples: Iterable[tuple[float, str, str, float, str] |
+                      tuple[float, str, str, float, str, str]],
     bucket_s: float,
     t0: float | None = None,
     t1: float | None = None,
@@ -246,6 +254,13 @@ def bucketize(
     Every bucket carries the full (component, resource) keyset observed
     anywhere in the range, zero-filled when silent, so the metric-series
     matrix is rectangular — the property featurization requires.
+
+    Aggregation is PER SERIES first (the optional 6th sample element; a
+    multi-container pod has one cumulative counter per container, and
+    interleaving two counters would read as resets and giant jumps), then
+    summed across the key's series: counters sum their per-bucket
+    increases, gauges sum their per-bucket means (a pod's memory is the
+    sum of its containers').
     """
     traces = list(traces)
     samples = list(samples)
@@ -271,29 +286,31 @@ def bucketize(
         if i is not None:
             trace_buckets[i].append(root)
 
-    # (component, resource) → per-bucket accumulators
-    gauge_sum: dict[tuple[str, str], list[float]] = {}
-    gauge_cnt: dict[tuple[str, str], list[int]] = {}
-    counter_vals: dict[tuple[str, str], list[list[tuple[float, float]]]] = {}
-    modes: dict[tuple[str, str], str] = {}
-    for ts, comp, res, val, mode in samples:
+    # (component, resource, series) → per-bucket accumulators
+    SKey = tuple  # (comp, res, series_id)
+    gauge_sum: dict[SKey, list[float]] = {}
+    gauge_cnt: dict[SKey, list[int]] = {}
+    counter_vals: dict[SKey, list[list[tuple[float, float]]]] = {}
+    modes: dict[SKey, str] = {}
+    for sample in samples:
+        ts, comp, res, val, mode = sample[:5]
+        sid = sample[5] if len(sample) > 5 else ""
         i = idx(ts)
         if i is None:
             continue
-        key = (comp, res)
-        modes[key] = mode
+        skey = (comp, res, sid)
+        modes[skey] = mode
         if mode == "counter":
-            counter_vals.setdefault(key, [[] for _ in range(n)])[i].append(
+            counter_vals.setdefault(skey, [[] for _ in range(n)])[i].append(
                 (ts, val))
         else:
-            gauge_sum.setdefault(key, [0.0] * n)[i] += val
-            gauge_cnt.setdefault(key, [0] * n)[i] += 1
+            gauge_sum.setdefault(skey, [0.0] * n)[i] += val
+            gauge_cnt.setdefault(skey, [0] * n)[i] += 1
 
-    keys = sorted(modes)
     values: dict[tuple[str, str], list[float]] = {}
-    for key in keys:
-        if modes[key] == "counter":
-            per = counter_vals[key]
+    for skey, mode in modes.items():
+        if mode == "counter":
+            per = counter_vals[skey]
             vals = [0.0] * n
             prev_last: float | None = None
             for i in range(n):
@@ -310,14 +327,18 @@ def bucketize(
                     last = v
                 vals[i] = inc
                 prev_last = last if last is not None else prev_last
-            values[key] = vals
         else:
-            values[key] = [
-                gauge_sum[key][i] / gauge_cnt[key][i]
-                if gauge_cnt[key][i] else 0.0
+            vals = [
+                gauge_sum[skey][i] / gauge_cnt[skey][i]
+                if gauge_cnt[skey][i] else 0.0
                 for i in range(n)
             ]
+        key = (skey[0], skey[1])
+        acc = values.setdefault(key, [0.0] * n)
+        for i in range(n):
+            acc[i] += vals[i]
 
+    keys = sorted(values)
     buckets = []
     for i in range(n):
         metrics = [MetricSample(component=c, resource=r,

@@ -107,6 +107,46 @@ def _gru_scan(
     return jnp.moveaxis(outs, 0, 2)  # [T,E,B,H] -> [E,B,T,H]
 
 
+def _kernel_io_dtype(dtype) -> jnp.dtype:
+    """bf16 proj stays bf16 (the producing einsum already quantized the
+    values, so wider storage only doubles the recurrence's dominant HBM
+    stream — proj in, dproj out); anything else upcasts to f32.  The
+    kernel itself always computes in f32 (per-block VMEM upcast)."""
+    return jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+
+
+def _project(params: GRUParams, x: jax.Array) -> jax.Array:
+    """Hoisted input projection ``x @ W_ih + b_ih`` → [E, T, B, 3H] in the
+    kernel's I/O dtype."""
+    eq = "btf,efg->etbg" if x.ndim == 3 else "ebtf,efg->etbg"
+    proj = jnp.einsum(eq, x, params.w_ih) + params.b_ih[:, None, None, :]
+    return proj.astype(_kernel_io_dtype(proj.dtype))
+
+
+def _pad_proj(proj: jax.Array, b_pad: int, e_pad: int, t_pad: int) -> jax.Array:
+    """Shape hygiene for the kernel's tiling constraints.  The time pad
+    sits at the END of scan order (callers flip BEFORE padding), beyond
+    every real output: sliced off afterwards, zero incoming gradient in
+    the VJP."""
+    e, t, b, _ = proj.shape
+    if b_pad != b:
+        proj = jnp.pad(proj, ((0, 0), (0, 0), (0, b_pad - b), (0, 0)))
+    if e_pad:
+        proj = jnp.pad(proj, ((0, e_pad), (0, 0), (0, 0), (0, 0)))
+    if t_pad:
+        proj = jnp.pad(proj, ((0, 0), (0, t_pad), (0, 0), (0, 0)))
+    return proj
+
+
+def _pad_weights(params: GRUParams, e_pad: int):
+    w_hh = params.w_hh.astype(jnp.float32)
+    b_hh = params.b_hh.astype(jnp.float32)
+    if e_pad:
+        w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
+        b_hh = jnp.pad(b_hh, ((0, e_pad), (0, 0)))
+    return w_hh, b_hh
+
+
 def _gru_pallas(
     params: GRUParams,
     x: jax.Array,
@@ -119,42 +159,20 @@ def _gru_pallas(
     layout/time-alignment; see that module for the kernel design."""
     from deeprest_tpu.ops import pallas_gru
 
-    if x.ndim == 3:
-        proj = jnp.einsum("btf,efg->etbg", x, params.w_ih)
-    else:
-        proj = jnp.einsum("ebtf,efg->etbg", x, params.w_ih)
-    proj = proj + params.b_ih[:, None, None, :]
-
-    # The kernel computes in f32; feeding it sub-32-bit operands would also
-    # tighten the sublane tiling granularity (bf16 needs 16 rows, not 8) on
-    # the batch axis of every [.., B, ..] block.  Upcast at the boundary so
-    # pad_batch's f32 granularity is always valid regardless of the model's
-    # compute dtype.
-    proj = proj.astype(jnp.float32)
-    h0 = h0.astype(jnp.float32)
-
+    proj = _project(params, x)
     e, t, b, _ = proj.shape
-    b_pad = pallas_gru.pad_batch(b)
-    if b_pad != b:
-        proj = jnp.pad(proj, ((0, 0), (0, 0), (0, b_pad - b), (0, 0)))
-        h0 = jnp.pad(h0, ((0, 0), (0, b_pad - b), (0, 0)))
+    b_pad = pallas_gru.pad_batch(b, proj.dtype)
     e_pad = -e % pallas_gru.E_BLK
-    w_hh = params.w_hh.astype(jnp.float32)
-    b_hh = params.b_hh.astype(jnp.float32)
-    if e_pad:
-        proj = jnp.pad(proj, ((0, e_pad), (0, 0), (0, 0), (0, 0)))
-        w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
-        b_hh = jnp.pad(b_hh, ((0, e_pad), (0, 0)))
-        h0 = jnp.pad(h0, ((0, e_pad), (0, 0), (0, 0)))
+    t_pad = pallas_gru.pad_time(t) - t
     if reverse:
         proj = jnp.flip(proj, axis=1)
-    # Pad the time axis (AFTER the flip, so padding sits at the END of scan
-    # order) up to a T_BLK multiple; the tail steps compute values beyond
-    # every real output and are sliced off — in the VJP their incoming
-    # gradients are exactly zero, so they contribute nothing.
-    t_pad = pallas_gru.pad_time(t) - t
-    if t_pad:
-        proj = jnp.pad(proj, ((0, 0), (0, t_pad), (0, 0), (0, 0)))
+    proj = _pad_proj(proj, b_pad, e_pad, t_pad)
+    w_hh, b_hh = _pad_weights(params, e_pad)
+    h0 = h0.astype(jnp.float32)
+    if b_pad != b:
+        h0 = jnp.pad(h0, ((0, 0), (0, b_pad - b), (0, 0)))
+    if e_pad:
+        h0 = jnp.pad(h0, ((0, e_pad), (0, 0), (0, 0)))
     h_all = pallas_gru.gru_recurrence(proj, w_hh, b_hh, h0, interpret)
     if t_pad:
         h_all = h_all[:, :t]
@@ -241,40 +259,17 @@ def _bidir_pallas(
     t = x.shape[-2]
     h = fwd.hidden_size
 
-    eq = "btf,efg->etbg" if x.ndim == 3 else "ebtf,efg->etbg"
-    proj_f = jnp.einsum(eq, x, fwd.w_ih) + fwd.b_ih[:, None, None, :]
-    proj_b = jnp.einsum(eq, x, bwd.w_ih) + bwd.b_ih[:, None, None, :]
-    # Kernel computes in f32 (see _gru_pallas for the tiling rationale).
-    proj_f = proj_f.astype(jnp.float32)
-    proj_b = jnp.flip(proj_b, axis=1).astype(jnp.float32)
+    proj_f = _project(fwd, x)
+    proj_b = jnp.flip(_project(bwd, x), axis=1)   # flip BEFORE padding
 
-    b_pad = pallas_gru.pad_batch(b)
+    b_pad = pallas_gru.pad_batch(b, proj_f.dtype)
     e_pad = -e % pallas_gru.E_BLK
     t_pad = pallas_gru.pad_time(t) - t
 
-    def prep(proj):
-        if b_pad != b:
-            proj = jnp.pad(proj, ((0, 0), (0, 0), (0, b_pad - b), (0, 0)))
-        if e_pad:
-            proj = jnp.pad(proj, ((0, e_pad), (0, 0), (0, 0), (0, 0)))
-        if t_pad:
-            # Padding sits at the END of scan order (the bwd proj is
-            # already flipped), beyond every real output: sliced off below,
-            # zero incoming gradient in the VJP.
-            proj = jnp.pad(proj, ((0, 0), (0, t_pad), (0, 0), (0, 0)))
-        return proj
-
-    def prep_w(p: GRUParams):
-        w_hh = p.w_hh.astype(jnp.float32)
-        b_hh = p.b_hh.astype(jnp.float32)
-        if e_pad:
-            w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
-            b_hh = jnp.pad(b_hh, ((0, e_pad), (0, 0)))
-        return w_hh, b_hh
-
-    proj = jnp.concatenate([prep(proj_f), prep(proj_b)], axis=0)
-    wf, bf = prep_w(fwd)
-    wb, bb = prep_w(bwd)
+    proj = jnp.concatenate([_pad_proj(proj_f, b_pad, e_pad, t_pad),
+                            _pad_proj(proj_b, b_pad, e_pad, t_pad)], axis=0)
+    wf, bf = _pad_weights(fwd, e_pad)
+    wb, bb = _pad_weights(bwd, e_pad)
     w_hh = jnp.concatenate([wf, wb], axis=0)
     b_hh = jnp.concatenate([bf, bb], axis=0)
     h0 = jnp.zeros((2 * (e + e_pad), b_pad, h), jnp.float32)

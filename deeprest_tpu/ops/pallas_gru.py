@@ -246,7 +246,9 @@ def gru_recurrence(proj, w_hh, b_hh, h0, interpret=False):
 
     Args:
       proj: ``[E, T, B, 3H]`` — ``x @ W_ih + b_ih`` per expert (gate order
-        r, z, n along the last axis).
+        r, z, n along the last axis); f32 or bf16 (the kernel upcasts each
+        block to f32 in VMEM; bf16 I/O halves the dominant HBM stream and
+        ``dproj`` comes back in the same dtype).
       w_hh: ``[E, H, 3H]`` hidden-to-hidden weights.
       b_hh: ``[E, 3H]`` hidden bias.
       h0: ``[E, B, H]`` initial hidden state.
@@ -282,9 +284,15 @@ gru_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
 # ---------------------------------------------------------------------------
 
 
-def pad_batch(b: int) -> int:
-    """Round the batch up to the f32 sublane granularity."""
-    return int(np.ceil(b / _SUBLANE) * _SUBLANE)
+def pad_batch(b: int, dtype=None) -> int:
+    """Round the batch up to the sublane granularity of ``dtype``.
+
+    The batch is the second-minor axis of every ``[.., B, 3H/H]`` block:
+    f32 tiles need B % 8 == 0, bf16 tiles B % 16 == 0."""
+    import jax.numpy as jnp
+
+    gran = 2 * _SUBLANE if dtype == jnp.bfloat16 else _SUBLANE
+    return int(np.ceil(b / gran) * gran)
 
 
 def pad_time(t: int) -> int:

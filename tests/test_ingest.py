@@ -200,10 +200,35 @@ def test_prometheus_series_maps_components_and_skips_unknown():
          "values": [[T0, "NaN"], [T0 + 1, "2048"]]},
     ]}}
     got = prometheus_series(payload)
-    assert ("compose-svc", "cpu") in {(c, r) for _, c, r, _, _ in got}
-    assert ("store-db", "memory") in {(c, r) for _, c, r, _, _ in got}
-    assert all(c != "x" for _, c, _, _, _ in got)     # unmapped skipped
+    assert ("compose-svc", "cpu") in {(s[1], s[2]) for s in got}
+    assert ("store-db", "memory") in {(s[1], s[2]) for s in got}
+    assert all(s[1] != "x" for s in got)              # unmapped skipped
     assert len([s for s in got if s[1] == "store-db"]) == 1  # NaN dropped
+
+
+def test_multi_series_per_key_aggregates_per_series_first():
+    """A multi-container pod has one cumulative counter PER container under
+    the same (component, resource) key; increases must be computed within
+    each series and summed — interleaving them would read as resets and
+    giant jumps.  Gauges sum their per-series means (pod memory = sum of
+    containers')."""
+    ts = [T0 + (i + 0.5) * BUCKET_S for i in range(3)]
+    samples = []
+    # two counters: increases (., 1, 1) and (., 1000, 1000) -> summed
+    for i, cum in enumerate([1000.0, 1001.0, 1002.0]):
+        samples.append((ts[i], "pod", "cpu", cum, "counter", "ctr-a"))
+    for i, cum in enumerate([5.0, 1005.0, 2005.0]):
+        samples.append((ts[i], "pod", "cpu", cum, "counter", "ctr-b"))
+    # two gauges: per-bucket means 10 and 20 -> summed to 30
+    for i in range(3):
+        samples.append((ts[i], "pod", "memory", 10.0, "gauge", "ctr-a"))
+        samples.append((ts[i], "pod", "memory", 20.0, "gauge", "ctr-b"))
+    buckets = bucketize([], samples, BUCKET_S)
+    cpu = [m.value for b in buckets for m in b.metrics if m.resource == "cpu"]
+    mem = [m.value for b in buckets for m in b.metrics
+           if m.resource == "memory"]
+    assert cpu == pytest.approx([0.0, 1001.0, 1001.0])   # 1+1000 per bucket
+    assert mem == pytest.approx([30.0, 30.0, 30.0])
 
 
 def test_jaeger_orphan_spans_become_roots():

@@ -109,6 +109,39 @@ def test_fused_bidirectional_distinct_params_odd_shapes():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_bf16_proj_io_matches_bf16_scan():
+    """With bf16 params/inputs the kernel keeps bf16 proj I/O (the einsum
+    already quantized the values — storing f32 would just double the
+    dominant HBM stream).  Outputs and grads must match the bf16 scan
+    within bf16 quantization noise; the f32 path stays exact."""
+    e, b, t, f, h = 3, 5, 9, 7, 128
+    kf, kb, kx = jax.random.split(jax.random.PRNGKey(3), 3)
+    fwd = init_gru_params(kf, e, f, h)
+    bwd = init_gru_params(kb, e, f, h)
+    x = jax.random.normal(kx, (b, t, f))
+    fwd16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), fwd)
+    bwd16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), bwd)
+    x16 = x.astype(jnp.bfloat16)
+
+    ref = np.asarray(
+        bidirectional_gru(fwd16, bwd16, x16, backend="scan"), np.float32)
+    pl = np.asarray(
+        bidirectional_gru(fwd16, bwd16, x16, backend="pallas_interpret"),
+        np.float32)
+    assert np.max(np.abs(ref - pl)) < 0.05
+
+    def loss(ps, backend):
+        out = bidirectional_gru(ps[0], ps[1], x16, backend=backend)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(lambda ps: loss(ps, "scan"))((fwd16, bwd16))
+    g_pl = jax.grad(lambda ps: loss(ps, "pallas_interpret"))((fwd16, bwd16))
+    for a, b_ in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pl)):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        assert np.max(np.abs(a - b_)) < 0.15 * (1e-3 + np.max(np.abs(a)))
+
+
 def test_gradient_wrt_input_matches_scan():
     params, x, _ = _setup()
 
