@@ -123,13 +123,20 @@ class QuantileGRU(nn.Module):
             mix = (total - rnn_out) / (e - 1)                         # [E,B,T,D]
         else:
             mix = rnn_out
-        head_in = jnp.concatenate([mix, rnn_out], axis=-1)            # [E,B,T,2D]
 
-        d_in = head_in.shape[-1]
+        # The head consumes concat(mix, own) along the feature axis
+        # (reference: qrnn.py:50-53).  The weight KEEPS that [E, 2D, Q]
+        # layout (checkpoint compatibility), but the einsum is split over
+        # the two halves instead of materializing the [E,B,T,2D]
+        # concatenation — at flagship scale that intermediate is ~157 MB
+        # of pure HBM traffic for an op XLA cannot always fuse away.
+        d = rnn_out.shape[-1]
+        d_in = 2 * d
         k_d = 1.0 / d_in ** 0.5
         head_w = self.param("head_w", uniform_pm(k_d), (e, d_in, q))
         head_b = self.param("head_b", uniform_pm(k_d), (e, q))
-        preds = jnp.einsum("ebtd,edq->ebtq", head_in, head_w)
+        preds = (jnp.einsum("ebtd,edq->ebtq", mix, head_w[:, :d])
+                 + jnp.einsum("ebtd,edq->ebtq", rnn_out, head_w[:, d:]))
         preds = preds + head_b[:, None, None, :]
         return jnp.transpose(preds, (1, 2, 0, 3))                     # [B,T,E,Q]
 

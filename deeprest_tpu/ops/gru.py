@@ -110,8 +110,10 @@ def _gru_scan(
 def _kernel_io_dtype(dtype) -> jnp.dtype:
     """bf16 proj stays bf16 (the producing einsum already quantized the
     values, so wider storage only doubles the recurrence's dominant HBM
-    stream — proj in, dproj out); anything else upcasts to f32.  The
-    kernel itself always computes in f32 (per-block VMEM upcast)."""
+    stream — proj in, dproj out); anything else upcasts to f32.  For bf16
+    models the kernel also runs its matmuls in bf16 (f32 accumulate) and
+    W_hh ships in bf16; the hidden-state CARRY and all gate elementwise
+    math stay f32 in VMEM (pallas_gru._dot_dtype_for)."""
     return jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
 
 
@@ -138,8 +140,11 @@ def _pad_proj(proj: jax.Array, b_pad: int, e_pad: int, t_pad: int) -> jax.Array:
     return proj
 
 
-def _pad_weights(params: GRUParams, e_pad: int):
-    w_hh = params.w_hh.astype(jnp.float32)
+def _pad_weights(params: GRUParams, e_pad: int, io_dtype):
+    # W_hh ships in the dot dtype: for bf16 models an f32 copy would
+    # double its HBM/VMEM footprint only to be downcast inside every grid
+    # program.  b_hh stays f32 (it is ADDED to the f32 accumulator).
+    w_hh = params.w_hh.astype(io_dtype)
     b_hh = params.b_hh.astype(jnp.float32)
     if e_pad:
         w_hh = jnp.pad(w_hh, ((0, e_pad), (0, 0), (0, 0)))
@@ -167,7 +172,7 @@ def _gru_pallas(
     if reverse:
         proj = jnp.flip(proj, axis=1)
     proj = _pad_proj(proj, b_pad, e_pad, t_pad)
-    w_hh, b_hh = _pad_weights(params, e_pad)
+    w_hh, b_hh = _pad_weights(params, e_pad, proj.dtype)
     h0 = h0.astype(jnp.float32)
     if b_pad != b:
         h0 = jnp.pad(h0, ((0, 0), (0, b_pad - b), (0, 0)))
@@ -268,8 +273,8 @@ def _bidir_pallas(
 
     proj = jnp.concatenate([_pad_proj(proj_f, b_pad, e_pad, t_pad),
                             _pad_proj(proj_b, b_pad, e_pad, t_pad)], axis=0)
-    wf, bf = _pad_weights(fwd, e_pad)
-    wb, bb = _pad_weights(bwd, e_pad)
+    wf, bf = _pad_weights(fwd, e_pad, proj_f.dtype)
+    wb, bb = _pad_weights(bwd, e_pad, proj_f.dtype)
     w_hh = jnp.concatenate([wf, wb], axis=0)
     b_hh = jnp.concatenate([bf, bb], axis=0)
     h0 = jnp.zeros((2 * (e + e_pad), b_pad, h), jnp.float32)

@@ -70,7 +70,7 @@ def _gates(xproj, gates_h):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
+def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr, *, dot_dtype):
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -79,12 +79,13 @@ def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
 
     n_e, t_blk = proj_ref.shape[0], proj_ref.shape[1]
     hs = [h_scr[i] for i in range(n_e)]
-    ws = [w_ref[i].astype(jnp.float32) for i in range(n_e)]
+    ws = [w_ref[i].astype(dot_dtype) for i in range(n_e)]
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
     for tt in range(t_blk):           # time OUTER
         for i in range(n_e):          # experts INNER: independent matmuls
             gates_h = (
-                jax.lax.dot_general(hs[i], ws[i], (((1,), (0,)), ((), ())),
+                jax.lax.dot_general(hs[i].astype(dot_dtype), ws[i],
+                                    (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
                 + bs[i]
             )
@@ -96,6 +97,14 @@ def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
         h_scr[i] = hs[i]
 
 
+def _dot_dtype_for(proj_dtype):
+    """bf16 models run the recurrence matmuls in bf16 with f32 accumulation
+    (an f32 matmul costs ~3x the MXU passes of bf16 and the model's own
+    dtype is bf16 — the hidden-state CARRY stays f32 in VMEM either way);
+    f32 models keep exact f32 dots."""
+    return jnp.bfloat16 if proj_dtype == jnp.bfloat16 else jnp.float32
+
+
 def _fwd_call(proj, w_hh, b_hh, h0, interpret):
     e, t, b, g3 = proj.shape
     h = g3 // 3
@@ -104,7 +113,7 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret):
     e_blk = e // eb
     grid = (eb, t // T_BLK)
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((e_blk, T_BLK, b, g3), lambda i, j: (i, j, 0, 0)),
@@ -129,7 +138,7 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret):
 
 def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
                 dproj_ref, dw_ref, db_ref, dh0_ref,
-                dh_scr, dw_scr, db_scr):
+                dh_scr, dw_scr, db_scr, *, dot_dtype):
     t = pl.program_id(1)
     t_total = pl.num_programs(1)
 
@@ -140,7 +149,7 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
         db_scr[...] = jnp.zeros_like(db_scr)
 
     n_e, t_blk = proj_ref.shape[0], proj_ref.shape[1]
-    ws = [w_ref[i].astype(jnp.float32) for i in range(n_e)]
+    ws = [w_ref[i].astype(dot_dtype) for i in range(n_e)]
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
     dhs = [dh_scr[i] for i in range(n_e)]
     dws = [dw_scr[i] for i in range(n_e)]
@@ -149,7 +158,8 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
         for i in range(n_e):           # experts INNER: independent matmuls
             h_prev = hprev_ref[i, tt].astype(jnp.float32)
             gates_h = (
-                jax.lax.dot_general(h_prev, ws[i], (((1,), (0,)), ((), ())),
+                jax.lax.dot_general(h_prev.astype(dot_dtype), ws[i],
+                                    (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
                 + bs[i]
             )
@@ -170,12 +180,13 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
 
             # dh_prev = dh·z + dgates_h @ W_hhᵀ   (contract the 3H axis)
             dhs[i] = dh_total * z + jax.lax.dot_general(
-                dgates_h, ws[i], (((1,), (1,)), ((), ())),
+                dgates_h.astype(dot_dtype), ws[i], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             # dW_hh += h_prevᵀ @ dgates_h   (contract the batch axis)
             dws[i] = dws[i] + jax.lax.dot_general(
-                h_prev, dgates_h, (((0,), (0,)), ((), ())),
+                h_prev.astype(dot_dtype), dgates_h.astype(dot_dtype),
+                (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             dbs[i] = dbs[i] + jnp.sum(dgates_h, axis=0)
@@ -201,7 +212,7 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     grid = (eb, nb)
     rev = lambda i, j: (i, nb - 1 - j, 0, 0)  # walk time blocks back-to-front
     dproj, dw, db, dh0 = pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((e_blk, T_BLK, b, g3), rev),
@@ -246,8 +257,10 @@ def gru_recurrence(proj, w_hh, b_hh, h0, interpret=False):
 
     Args:
       proj: ``[E, T, B, 3H]`` — ``x @ W_ih + b_ih`` per expert (gate order
-        r, z, n along the last axis); f32 or bf16 (the kernel upcasts each
-        block to f32 in VMEM; bf16 I/O halves the dominant HBM stream and
+        r, z, n along the last axis); f32 or bf16.  bf16 proj selects the
+        bf16-dot path (_dot_dtype_for): matmuls run bf16 with f32
+        accumulation while the carry and gate math stay f32 in VMEM —
+        bf16 I/O also halves the dominant HBM stream, and
         ``dproj`` comes back in the same dtype).
       w_hh: ``[E, H, 3H]`` hidden-to-hidden weights.
       b_hh: ``[E, 3H]`` hidden bias.

@@ -25,6 +25,15 @@ import pytest
 from deeprest_tpu.data.schema import Bucket, MetricSample, Span
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests — live-cluster e2e, multihost, jit-compile-heavy "
+        "model/training paths.  Quick tier: `pytest -m 'not slow'` "
+        "(~3 min); full suite runs everything.",
+    )
+
+
 def _span(component, operation, *children):
     return Span(component=component, operation=operation, children=list(children))
 

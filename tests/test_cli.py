@@ -36,6 +36,7 @@ def pipeline(tmp_path_factory):
             "preds": preds, "root": root}
 
 
+@pytest.mark.slow
 def test_simulate_and_featurize_artifacts(pipeline):
     data = FeaturizedData.load(pipeline["feats"])
     assert data.traffic.shape[0] == 140
@@ -49,6 +50,7 @@ def test_simulate_and_featurize_artifacts(pipeline):
     assert data.space.to_dict() == data2.space.to_dict()
 
 
+@pytest.mark.slow
 def test_train_artifacts(pipeline):
     assert os.path.isdir(pipeline["ckpt"])
     assert any(name.startswith("step_") for name in os.listdir(pipeline["ckpt"]))
@@ -58,6 +60,7 @@ def test_train_artifacts(pipeline):
     assert len(pngs) == len(data.metric_names) + 1   # + learning curve
 
 
+@pytest.mark.slow
 def test_predict_artifacts(pipeline):
     data = FeaturizedData.load(pipeline["feats"])
     with np.load(pipeline["preds"]) as z:
@@ -68,6 +71,7 @@ def test_predict_artifacts(pipeline):
     assert np.all(np.isfinite(preds))
 
 
+@pytest.mark.slow
 def test_synthesize_from_raw(pipeline, capsys):
     out = str(pipeline["root"] / "synthetic.npz")
     data = FeaturizedData.load(pipeline["feats"])
@@ -83,6 +87,7 @@ def test_synthesize_from_raw(pipeline, capsys):
     assert np.all(series.sum(axis=1) >= 7)
 
 
+@pytest.mark.slow
 def test_anomaly_command_contract(pipeline, capsys):
     # Detector quality is covered in test_serve.py; here: the command runs,
     # emits one report per metric plus a JSON summary, and exit code stays 0
@@ -102,6 +107,7 @@ def test_featurize_requires_input():
         main(["featurize"])
 
 
+@pytest.mark.slow
 def test_predict_raw_uses_checkpoint_space(pipeline):
     """--raw at serve time must featurize against the checkpoint's space,
     not a freshly grown vocabulary (whose column order depends on corpus
@@ -123,6 +129,7 @@ def test_predict_raw_uses_checkpoint_space(pipeline):
         assert z["predictions"].shape == (25, len(pred.metric_names), 3)
 
 
+@pytest.mark.slow
 def test_predict_rejects_mismatched_vocabulary(pipeline, tmp_path):
     """--features extracted with a different vocabulary (same width) must be
     rejected, not silently fed to the model with permuted columns."""
@@ -149,6 +156,7 @@ def test_featurize_out_without_extension(tmp_path):
     assert data.traffic.shape[0] == 5
 
 
+@pytest.mark.slow
 def test_train_profile_capture(pipeline, tmp_path):
     """--profile-dir captures a jax.profiler trace of the first epoch
     (SURVEY.md §5.1: the ML-plane profiling the reference lacks)."""
@@ -164,6 +172,7 @@ def test_train_profile_capture(pipeline, tmp_path):
     assert os.path.getsize(planes[0]) > 0
 
 
+@pytest.mark.slow
 def test_train_mesh_flag_runs_sharded(pipeline, tmp_path):
     """--mesh lays the full (data, expert, model) mesh under the train CLI
     (8 virtual CPU devices via conftest)."""
@@ -175,6 +184,7 @@ def test_train_mesh_flag_runs_sharded(pipeline, tmp_path):
     assert any(n.startswith("step_") for n in os.listdir(ckpt))
 
 
+@pytest.mark.slow
 def test_train_mesh_flag_rejects_garbage(pipeline):
     import pytest
 

@@ -16,6 +16,10 @@ from deeprest_tpu.demo.results import ResultsStore
 from deeprest_tpu.demo.server import DemoServer
 from deeprest_tpu.serve.predictor import Predictor
 
+# Module-scoped fixtures here train/boot heavy state: the whole
+# file belongs to the slow tier (README: testing tiers).
+pytestmark = pytest.mark.slow
+
 TICKS = 30
 WINDOW = 12
 

@@ -4,6 +4,7 @@ the full contract of the reference's featurize.py + estimate.py + qrnn.py
 exercised with zero cluster dependencies."""
 
 import numpy as np
+import pytest
 
 from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
 from deeprest_tpu.data.featurize import featurize_buckets
@@ -15,6 +16,10 @@ from deeprest_tpu.train import (
 from deeprest_tpu.train.metrics import format_report
 
 from conftest import make_series_buckets
+
+# Module-scoped fixtures here train/boot heavy state: the whole
+# file belongs to the slow tier (README: testing tiers).
+pytestmark = pytest.mark.slow
 
 CFG = Config(
     model=ModelConfig(hidden_size=8, dropout_rate=0.1),

@@ -20,6 +20,10 @@ from deeprest_tpu.serve import (
 from deeprest_tpu.train import Trainer, prepare_dataset
 from deeprest_tpu.workload import Anomaly, crypto_scenario, normal_scenario, simulate_corpus
 
+# Module-scoped fixtures here train/boot heavy state: the whole
+# file belongs to the slow tier (README: testing tiers).
+pytestmark = pytest.mark.slow
+
 CFG = Config(
     model=ModelConfig(hidden_size=8, dropout_rate=0.1),
     train=TrainConfig(num_epochs=4, batch_size=16, window_size=12,

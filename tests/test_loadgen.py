@@ -150,6 +150,7 @@ def test_live_corpus_write_telemetry_nonzero(live_corpus):
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_end_to_end_read_your_own_write(live_corpus, tmp_path):
     """Independent of the runner: a user's post must land on a follower's
     home timeline through the full native saga."""
@@ -175,6 +176,7 @@ def test_end_to_end_read_your_own_write(live_corpus, tmp_path):
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_burner_attributes_cpu_to_victim_component(tmp_path):
     """Cryptojack injection: with zero traffic, the victim component's CPU
     must still rise while the burner runs — the exact signal the anomaly
@@ -195,6 +197,7 @@ def test_burner_attributes_cpu_to_victim_component(tmp_path):
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_unregistered_burner_is_attributed_non_cooperatively(tmp_path):
     """The real threat model (VERDICT r3 missing #3): a compromised service
     spawns a miner that does NOT register with the collector.  The
@@ -252,6 +255,7 @@ def test_register_with_collector_frame_format():
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_collector_metrics_endpoint_live(tmp_path):
     """Live observability (round-2 verdict missing #3): while the cluster
     runs, the collector's /metrics endpoint must serve Prometheus-format
@@ -299,6 +303,7 @@ def test_collector_metrics_endpoint_live(tmp_path):
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_gateway_serves_browsable_pages(tmp_path):
     """The human-browsable static pages (reference: nginx-web-server/pages/)
     must load from the gateway, and the API they call must work with the

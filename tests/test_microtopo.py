@@ -69,6 +69,7 @@ def test_corpus_has_write_metrics_and_deep_paths():
     assert max(len(p) for p in space.vocabulary()) >= 4
 
 
+@pytest.mark.slow
 def test_train_at_trainticket_scale():
     """Featurize→train→eval with 200+ metric experts, loss finite and
     improving — the expert axis at an order of magnitude beyond the

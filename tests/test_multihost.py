@@ -19,6 +19,10 @@ import sys
 import numpy as np
 import pytest
 
+# Module-scoped fixtures here train/boot heavy state: the whole
+# file belongs to the slow tier (README: testing tiers).
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
 

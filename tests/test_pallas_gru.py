@@ -28,6 +28,7 @@ def _setup(seed=0, e=E, b=B, t=T, f=F, h=H):
 
 
 @pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.slow
 def test_forward_matches_scan(reverse):
     params, x, _ = _setup()
     ref = gru(params, x, reverse=reverse, backend="scan")
@@ -37,6 +38,7 @@ def test_forward_matches_scan(reverse):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_forward_aligned_shapes():
     # E multiple of E_BLK and B multiple of 8: the no-padding fast path.
     params, x, _ = _setup(e=8, b=16)
@@ -47,6 +49,7 @@ def test_forward_aligned_shapes():
 
 
 @pytest.mark.parametrize("t", [1, 2, 6, 12])
+@pytest.mark.slow
 def test_time_blocking_boundaries(t):
     # T below / equal to / a multiple of T_BLK: padding and the in-program
     # time loop must agree with scan in both directions, values and grads.
@@ -66,6 +69,7 @@ def test_time_blocking_boundaries(t):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gradients_match_scan():
     params, x, _ = _setup()
 
@@ -82,6 +86,7 @@ def test_gradients_match_scan():
         )
 
 
+@pytest.mark.slow
 def test_fused_bidirectional_distinct_params_odd_shapes():
     """The fused-bidirectional path (both directions stacked on the expert
     axis, one kernel invocation) must be exact against the scan backend
@@ -109,6 +114,7 @@ def test_fused_bidirectional_distinct_params_odd_shapes():
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bf16_proj_io_matches_bf16_scan():
     """With bf16 params/inputs the kernel keeps bf16 proj I/O (the einsum
     already quantized the values — storing f32 would just double the
@@ -142,6 +148,7 @@ def test_bf16_proj_io_matches_bf16_scan():
         assert np.max(np.abs(a - b_)) < 0.15 * (1e-3 + np.max(np.abs(a)))
 
 
+@pytest.mark.slow
 def test_gradient_wrt_input_matches_scan():
     params, x, _ = _setup()
 
@@ -154,6 +161,7 @@ def test_gradient_wrt_input_matches_scan():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_model_parity_across_backends():
     """The full QuantileGRU forward agrees between backends."""
     import dataclasses

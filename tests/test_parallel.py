@@ -63,6 +63,7 @@ def test_sharded_params_placement(bundle):
     MeshConfig(data=2, expert=2, model=2),
     MeshConfig(data=4, expert=1, model=2),
 ])
+@pytest.mark.slow
 def test_sharded_training_matches_single_device(bundle, mesh_cfg):
     single = Trainer(SMALL, bundle.feature_dim, bundle.metric_names,
                      mesh=make_mesh(MeshConfig()))
@@ -90,6 +91,7 @@ def test_shard_batch_divisibility():
     assert len(xs.sharding.device_set) == 4
 
 
+@pytest.mark.slow
 def test_pallas_kernel_under_sharded_mesh():
     """The fused pallas recurrence (interpret mode, H=128 so the kernel
     engages) must run inside the 2x2x2-sharded train step and match the
@@ -106,6 +108,7 @@ def test_pallas_kernel_under_sharded_mesh():
     np.testing.assert_allclose(loss_pallas, loss_scan, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_flagship_shape_sharded_step():
     """One flagship-shape (F=512, E=40, H=128, W=60, bf16) train step over
     the full 2x2x2 mesh — the shape where layout/sharding bugs actually
@@ -119,6 +122,7 @@ def test_flagship_shape_sharded_step():
     assert np.isfinite(loss) and np.isfinite(test_loss)
 
 
+@pytest.mark.slow
 def test_ten_k_endpoint_width_sharded_correctness():
     """The 10k-endpoint config (BASELINE.json configs[3]): hash-mode width
     F=10240 at flagship H=128 with a NON-TRIVIAL model (TP) axis — the
@@ -257,6 +261,7 @@ def test_prefetch_to_device_preserves_order_and_values():
             np.testing.assert_array_equal(np.asarray(wb), batches[i][1])
 
 
+@pytest.mark.slow
 def test_training_identical_with_and_without_prefetch(bundle):
     import dataclasses
 

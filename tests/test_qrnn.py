@@ -2,6 +2,7 @@
 equal an explicit per-expert loop (masks applied to inputs, O(E²) mixing)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -44,6 +45,7 @@ def reference_forward(params, x, cfg):
     return np.stack(preds, axis=2)  # [B,T,E,Q]
 
 
+@pytest.mark.slow
 def test_forward_matches_explicit_loop():
     model, variables, x = init_model()
     got = np.asarray(model.apply(variables, x))
@@ -59,6 +61,7 @@ def test_output_shape_and_dtype():
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_single_metric_mix_fallback():
     cfg = ModelConfig(feature_dim=4, num_metrics=1, hidden_size=3)
     model, variables, x = init_model(cfg)
@@ -68,6 +71,7 @@ def test_single_metric_mix_fallback():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_dropout_train_vs_eval():
     model, variables, x = init_model()
     eval_a = model.apply(variables, x, deterministic=True)
@@ -123,6 +127,7 @@ def test_feature_dim_mismatch_raises():
         assert "feature_dim" in str(e)
 
 
+@pytest.mark.slow
 def test_stacked_layers():
     cfg = ModelConfig(feature_dim=6, num_metrics=2, hidden_size=4, num_layers=2)
     model, variables, x = init_model(cfg)

@@ -208,6 +208,7 @@ def test_expand_minmax_is_monotone():
     assert expand_minmax(None, a) is a
 
 
+@pytest.mark.slow
 def test_refresh_fits_per_feature_traffic_stats(tmp_path):
     """A hot traffic column must not compress other columns' dynamic range
     (round-2 verdict weak #8): stats are per feature, so each column's max
@@ -234,6 +235,7 @@ def test_refresh_fits_per_feature_traffic_stats(tmp_path):
     np.testing.assert_allclose(maxes[dead], glob)
 
 
+@pytest.mark.slow
 def test_quiet_column_keeps_own_scale():
     """A column that was active and then goes quiet (rotated out of the
     retained history) must keep its own observed range — not be misread as
@@ -264,6 +266,7 @@ def test_quiet_column_keeps_own_scale():
 # ---------------------------------------------------------------------------
 # Refresh + resume (no cluster)
 
+@pytest.mark.slow
 def test_refresh_trains_and_checkpoints(tmp_path):
     st = make_trainer(ckpt_dir=str(tmp_path / "ckpt"))
     for b in make_series_buckets(40, seed=1):
@@ -279,6 +282,7 @@ def test_refresh_trains_and_checkpoints(tmp_path):
     assert load_sidecar(str(tmp_path / "ckpt"))["stream_refresh_count"] == 1
 
 
+@pytest.mark.slow
 def test_resume_adopts_frozen_state(tmp_path):
     """A restarted stream must continue — same frozen metric set, same
     stats, same params — not restart (round-2 verdict weak #1: the resume
@@ -309,6 +313,7 @@ def test_resume_adopts_frozen_state(tmp_path):
     assert np.isfinite(r2.eval_loss)
 
 
+@pytest.mark.slow
 def test_resume_tolerates_counterless_or_malformed_sidecar(tmp_path, capsys):
     """Checkpoints without a stream counter (non-streaming Trainer.save, or
     a malformed value) must resume with numbering at 0 — never wedge."""
@@ -355,6 +360,7 @@ def test_tailer_recovers_from_same_size_replacement(tmp_path):
     assert got[0].to_dict() == buckets[2].to_dict()
 
 
+@pytest.mark.slow
 def test_stream_resume_skips_sidecarless_checkpoint(tmp_path, capsys):
     """A crash between the orbax save and the sidecar write leaves a
     sidecar-less step dir; resume must fall back to the newest complete
@@ -378,6 +384,7 @@ def test_stream_resume_skips_sidecarless_checkpoint(tmp_path, capsys):
     assert latest_step(ckpt) != good_step   # and it really was the older one
 
 
+@pytest.mark.slow
 def test_trainer_save_rejects_reserved_extra_keys(tmp_path):
     st = make_trainer(ckpt_dir=str(tmp_path / "ckpt"))
     for b in make_series_buckets(40, seed=1):
@@ -423,6 +430,7 @@ def test_tailer_recovers_from_file_rotation(tmp_path):
     assert got[0].to_dict() == buckets[3].to_dict()
 
 
+@pytest.mark.slow
 def test_resume_rejects_capacity_mismatch(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     st = make_trainer(ckpt_dir=ckpt)
@@ -437,6 +445,7 @@ def test_resume_rejects_capacity_mismatch(tmp_path):
                                            capacity=2 * CAPACITY))
 
 
+@pytest.mark.slow
 def test_late_metrics_dropped_with_warning(tmp_path, capsys):
     st = make_trainer()
     buckets = make_series_buckets(40, seed=1)
@@ -453,6 +462,7 @@ def test_late_metrics_dropped_with_warning(tmp_path, capsys):
     assert "brand-new-svc" in out and "dropping" in out
 
 
+@pytest.mark.slow
 def test_run_loop_drives_refreshes_from_growing_file(tmp_path):
     """st.run() against a file that grows while the loop polls."""
     path = str(tmp_path / "raw.jsonl")
@@ -475,6 +485,7 @@ def test_run_loop_drives_refreshes_from_growing_file(tmp_path):
     assert all(np.isfinite(r.eval_loss) for r in results)
 
 
+@pytest.mark.slow
 def test_cli_stream_runs_then_resumes(tmp_path):
     """The judge's round-2 repro: a second `stream` run against the same
     --ckpt-dir crashed with AttributeError before touching a bucket. Both
@@ -510,6 +521,7 @@ needs_snsd = pytest.mark.skipif(
 
 
 @needs_snsd
+@pytest.mark.slow
 def test_stream_live_cluster_end_to_end(tmp_path):
     out = str(tmp_path / "live.jsonl")
     ckpt = str(tmp_path / "ckpt")
@@ -567,6 +579,7 @@ def test_stream_live_cluster_end_to_end(tmp_path):
         cluster.stop(drain_s=0.5)
 
 
+@pytest.mark.slow
 def test_checkpoint_retention_bounds_disk(tmp_path):
     """A forever-streaming process must not grow the checkpoint dir without
     bound: only the newest keep_checkpoints steps survive, and resume still

@@ -51,6 +51,7 @@ def test_eval_window_indices():
                                   np.arange(0, 540, 60))
 
 
+@pytest.mark.slow
 def test_training_learns(bundle):
     trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
     state, history = trainer.fit(bundle, num_epochs=4)
@@ -76,6 +77,7 @@ def test_eval_with_baselines(bundle):
             [stats["median"], stats["p95"], stats["max"]], 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_eval_batching_matches_single_batch(bundle):
     """Paged eval (eval_batch_size < #windows) must reproduce the one-shot
     loss and MAE report exactly: chunking is a memory optimization, not a
@@ -108,6 +110,7 @@ def test_padded_batch_loss_exact():
     np.testing.assert_allclose(float(full), float(padded), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(bundle, tmp_path):
     trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
     state, _ = trainer.fit(bundle, num_epochs=1)
@@ -159,6 +162,7 @@ def test_hash_mode_requires_capacity():
     FeaturizeConfig(hash_features=True, capacity=256)  # fine
 
 
+@pytest.mark.slow
 def test_checkpoint_knobs_wired(bundle, tmp_path):
     import dataclasses
     cfg = dataclasses.replace(SMALL, train=dataclasses.replace(
@@ -174,6 +178,7 @@ def test_checkpoint_knobs_wired(bundle, tmp_path):
     assert extra["feature_dim"] == bundle.feature_dim
 
 
+@pytest.mark.slow
 def test_throughput_excludes_compile(bundle):
     trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
     state = trainer.init_state(bundle.x_train)
