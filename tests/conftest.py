@@ -30,7 +30,7 @@ def pytest_configure(config):
         "markers",
         "slow: heavy tests — live-cluster e2e, multihost, jit-compile-heavy "
         "model/training paths.  Quick tier: `pytest -m 'not slow'` "
-        "(~3 min); full suite runs everything.",
+        "(~100 s measured); full suite runs everything.",
     )
 
 
