@@ -89,6 +89,21 @@ def generate_unseen_corpus(scenario, num_buckets: int, space, path: str):
         app, endpoints = build_synthetic_app(scenario, SVC, EP, TOPO_SEED)
         write_corpus_jsonl(scenario, num_buckets, path, app=app,
                            endpoints=endpoints)
+    # Featurization cache: the Python span walk over a day-scale corpus is
+    # tens of minutes.  Keyed on the full hash-space identity (capacity,
+    # seed, mode) and only honored when NEWER than the corpus it was built
+    # from — a regenerated jsonl must invalidate it.
+    cfg = space.config
+    cache = (f"{path}.feat_c{cfg.capacity or 0}_s{cfg.hash_seed}"
+             f"{'_hash' if cfg.hash_features else '_dict'}.npz")
+    if os.path.exists(cache) and \
+            os.path.getmtime(cache) > os.path.getmtime(path):
+        z = np.load(cache)
+        keys = [str(k) for k in z["keys"]]
+        inv_names = [str(c) for c in z["inv_names"]]
+        invocations = {c: z["inv_values"][:, i]
+                       for i, c in enumerate(inv_names)}
+        return z["traffic"], z["metrics"], keys, invocations
     traffic_rows, metric_rows, keys = [], [], None
     inv_rows: list[dict[str, int]] = []
     for bucket in iter_raw_data_jsonl(path):
@@ -103,8 +118,18 @@ def generate_unseen_corpus(scenario, num_buckets: int, space, path: str):
         c: np.asarray([row.get(c, 0) for row in inv_rows], np.float32)
         for c in comps
     }
-    return (np.stack(traffic_rows), np.stack(metric_rows), keys,
-            invocations)
+    traffic = np.stack(traffic_rows)
+    metrics = np.stack(metric_rows)
+    try:
+        np.savez_compressed(
+            cache, traffic=traffic, metrics=metrics,
+            keys=np.array(keys),
+            inv_names=np.array(comps),
+            inv_values=np.stack([invocations[c] for c in comps], axis=-1)
+            if comps else np.zeros((len(traffic), 0), np.float32))
+    except OSError as exc:
+        print(f"featurize cache write failed (continuing): {exc}")
+    return traffic, metrics, keys, invocations
 
 
 def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
@@ -290,7 +315,6 @@ def main():
     keys, space = list(data0.metric_names), data0.space
     invocations = data0.invocations
     targets, metric_names = select_metrics(metrics, keys, N_METRICS)
-    sel_idx = [keys.index(n) for n in metric_names]
     print(f"corpus featurized: {traffic.shape} in {time.time()-t0:.0f}s",
           flush=True)
 
@@ -348,8 +372,14 @@ def main():
         t0 = time.time()
         u_traffic, u_metrics, u_keys, u_inv = generate_unseen_corpus(
             scenario, n_buckets, space, path)
-        assert u_keys == keys, "unseen corpus keyset != month keyset"
-        u_targets = u_metrics[:, sel_idx]
+        # Reindex by NAME: the unseen corpora can carry a superset of the
+        # month cache's keyset (quiet components that never fired in the
+        # cached featurization still declare their keys), so positional
+        # indexing would misalign.
+        u_index = {k: i for i, k in enumerate(u_keys)}
+        missing = [n for n in metric_names if n not in u_index]
+        assert not missing, f"unseen corpus lacks metrics: {missing[:5]}"
+        u_targets = u_metrics[:, [u_index[n] for n in metric_names]]
         errors = eval_corpus(trainer, state,
                              (bundle.x_stats, bundle.y_stats),
                              u_traffic, u_targets, metric_names, window,

@@ -115,6 +115,25 @@ class SnsCluster:
     def start(self, timeout: float = 20.0) -> "SnsCluster":
         if not snsd_available():
             raise RuntimeError(f"snsd not built at {snsd_path()} (make -C native/sns)")
+        # Sweep EMPTY leftover component cgroups from crashed/killed
+        # clusters (rmdir refuses non-empty dirs, so live clusters are
+        # untouched).  Without this, SIGKILLed runs would leak dirs
+        # forever — there is no owner left to clean them.  Only dirs older
+        # than a minute are swept: a concurrent cluster's service sits
+        # briefly between mkdir and its cgroup.procs write, and sweeping
+        # that window would silently strip its death-surviving CPU tier.
+        base = "/sys/fs/cgroup/cpuacct/deeprest"
+        try:
+            now = time.time()
+            for name in os.listdir(base):
+                full = os.path.join(base, name)
+                try:
+                    if now - os.stat(full).st_mtime > 60:
+                        os.rmdir(full)
+                except OSError:
+                    pass
+        except OSError:
+            pass  # no cgroupfs tier on this host
         named = list(STORES) + list(SERVICES) + list(GATEWAYS) + [COLLECTOR]
         ports = _free_ports(len(named) + 1)
         self.metrics_addr = ("127.0.0.1", ports.pop())
@@ -220,6 +239,30 @@ class SnsCluster:
             self._terminate(COLLECTOR)
             self._reap(COLLECTOR)
         self._procs.clear()
+        self._remove_cgroups()
+
+    def _remove_cgroups(self) -> None:
+        """Best-effort rmdir of this cluster's per-component cpuacct
+        cgroups (services self-placed into them at startup; a cgroup dir
+        is only removable once empty, i.e. after every member exited).
+        Same FNV-1a64(config_path) naming as native/sns/common.cpp."""
+        if not self._config_path:
+            return
+        h = 0xCBF29CE484222325
+        for b in self._config_path.encode():
+            h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        base = "/sys/fs/cgroup/cpuacct/deeprest"
+        prefix = f"{h:016x}_"
+        try:
+            names = os.listdir(base)
+        except OSError:
+            return  # no cgroupfs tier on this host
+        for name in names:
+            if name.startswith(prefix):
+                try:
+                    os.rmdir(os.path.join(base, name))
+                except OSError:
+                    pass  # member still exiting; next cluster run retries
 
     def _terminate(self, component: str) -> None:
         proc = self._procs.get(component)

@@ -278,7 +278,26 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
         }
       }
       const bool have_delta = any_ok && !first_scrape && dt > 0;
-      push("cpu", have_delta ? d_cpu / dt * 1000.0 : 0.0);  // millicores
+      // CPU source preference: the component's cgroup counter — it
+      // includes processes that LIVED AND DIED between scrapes, which
+      // /proc tree-walking structurally cannot (common.h Component
+      // cgroups).  Process-tree deltas remain the fallback on hosts
+      // without a writable cgroupfs.
+      double cg_ns = 0;
+      bool cg_ok = !options_.config_path.empty() &&
+                   ReadCgroupCpuNs(options_.config_path, component, &cg_ns);
+      if (cg_ok) {
+        auto prev_cg = last_cgroup_ns_.find(component);
+        if (prev_cg != last_cgroup_ns_.end() && dt > 0) {
+          push("cpu",
+               std::max(0.0, (cg_ns - prev_cg->second) / 1e9) / dt * 1000.0);
+        } else {
+          push("cpu", 0.0);  // first sighting: baseline only
+        }
+        last_cgroup_ns_[component] = cg_ns;
+      } else {
+        push("cpu", have_delta ? d_cpu / dt * 1000.0 : 0.0);  // millicores
+      }
       push("memory", any_ok ? rss : 0.0);
       if (!StoreKindFor(component).empty()) {
         push("write-iops", have_delta ? d_wsc / dt : 0.0);

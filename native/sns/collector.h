@@ -18,6 +18,9 @@ struct CollectorOptions {
   int interval_ms = 5000;  // scrape window = ML time-step (SURVEY.md §5.5)
   int grace_ms = 1000;     // quiet time before a trace is considered complete
   std::string output_path = "raw_data.jsonl";
+  // Cluster config path — keys the per-component cgroup names (see
+  // common.h Component cgroups).  Empty disables the cgroup CPU tier.
+  std::string config_path;
 };
 
 struct ProcSample {
@@ -58,6 +61,9 @@ class Collector {
   // whole process tree: per-pid deltas make unregistered children
   // (non-cooperative processes) attributable (see CutBucket).
   std::map<std::string, std::map<int, ProcSample>> last_samples_;
+  // component -> last cumulative cgroup cpuacct.usage (preferred CPU
+  // source: survives child death, counts every process in the cgroup).
+  std::map<std::string, double> last_cgroup_ns_;
   // live observability state (all guarded by mu_)
   std::map<std::pair<std::string, std::string>, double> latest_;
   uint64_t spans_ingested_ = 0;

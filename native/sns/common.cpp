@@ -7,6 +7,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
@@ -327,6 +328,58 @@ int AcceptWithTimeout(int listen_fd, int timeout_ms) {
 
 // ---------------------------------------------------------------------------
 // RpcServer
+
+uint64_t Fnv1a64(const std::string& s) {
+  uint64_t h = 14695981039346656037ull;  // FNV-1a 64 offset basis
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+static const char* kCgroupBase = "/sys/fs/cgroup/cpuacct/deeprest";
+
+std::string ComponentCgroupDir(const std::string& config_path,
+                               const std::string& component) {
+  char hex[17];
+  snprintf(hex, sizeof hex, "%016llx",
+           static_cast<unsigned long long>(Fnv1a64(config_path)));
+  return std::string(kCgroupBase) + "/" + hex + "_" + component;
+}
+
+bool JoinComponentCgroup(const std::string& config_path,
+                         const std::string& component) {
+  ::mkdir(kCgroupBase, 0755);  // EEXIST is fine; failure surfaces below
+  std::string dir = ComponentCgroupDir(config_path, component);
+  bool created = ::mkdir(dir.c_str(), 0755) == 0;
+  if (!created && errno != EEXIST) return false;
+  bool ok = false;
+  {
+    std::ofstream f(dir + "/cgroup.procs");
+    if (f) {
+      f << getpid() << "\n";
+      f.flush();
+      ok = f.good();
+    }
+  }
+  // A dir we created but could not join must not linger: the collector
+  // would prefer its never-advancing counter over the working /proc tier
+  // and report 0 CPU forever.
+  if (!ok && created) ::rmdir(dir.c_str());
+  return ok;
+}
+
+bool ReadCgroupCpuNs(const std::string& config_path,
+                     const std::string& component, double* out_ns) {
+  std::ifstream f(ComponentCgroupDir(config_path, component) +
+                  "/cpuacct.usage");
+  if (!f) return false;
+  double ns = 0;
+  if (!(f >> ns)) return false;
+  *out_ns = ns;
+  return true;
+}
 
 RpcServer::RpcServer(std::string component, int port)
     : component_(std::move(component)), port_(port) {

@@ -42,6 +42,28 @@ uint64_t MonoNs();     // CLOCK_MONOTONIC
 uint64_t RandomU64();  // thread-local xorshift, seeded from /dev/urandom
 
 // ---------------------------------------------------------------------------
+// Component cgroups (the cadvisor-equivalent measurement scope).  Each
+// service self-places into a per-cluster cpuacct cgroup at startup — the
+// process-cluster analog of a container runtime creating the pod cgroup —
+// so children (including injected/unregistered ones) inherit it and the
+// collector can read CPU that SURVIVES process death from cpuacct.usage
+// (reference: cadvisor scrape tier, minikube-openebs/
+// monitor-openebs-pg.yaml:142-143).  Names are keyed by FNV-1a64 of the
+// cluster config path so concurrent clusters never share a cgroup; the
+// same hash is reimplemented in deeprest_tpu/loadgen/cluster.py for
+// teardown.  All functions are best-effort: on hosts without a writable
+// cgroupfs everything degrades to the process-tree sampler.
+uint64_t Fnv1a64(const std::string& s);
+std::string ComponentCgroupDir(const std::string& config_path,
+                               const std::string& component);
+bool JoinComponentCgroup(const std::string& config_path,
+                         const std::string& component);
+// Cumulative ns of CPU consumed by the component's cgroup (all processes,
+// living and dead); returns false when the cgroup is absent/unreadable.
+bool ReadCgroupCpuNs(const std::string& config_path,
+                     const std::string& component, double* out_ns);
+
+// ---------------------------------------------------------------------------
 // Sockets + framed transport
 
 // A connected TCP stream carrying length-prefixed frames

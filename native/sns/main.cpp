@@ -148,6 +148,7 @@ int RunRole(const std::string& component, ClusterConfig& cfg, int argc,
     opts.interval_ms = std::stoi(ArgValue(argc, argv, "interval-ms", "5000"));
     opts.grace_ms = std::stoi(ArgValue(argc, argv, "grace-ms", "1000"));
     opts.output_path = ArgValue(argc, argv, "out", "raw_data.jsonl");
+    opts.config_path = ArgValue(argc, argv, "config");
     Collector collector(&cfg, opts);
     collector.Run(g_running);
   } else if (IsAppService(component)) {
@@ -343,6 +344,13 @@ int main(int argc, char** argv) {
   }
   try {
     sns::ClusterConfig cfg = sns::ClusterConfig::Load(config_path);
+    // Self-place into the per-cluster component cgroup (children inherit),
+    // the process-cluster analog of a container runtime creating the pod
+    // cgroup — gives the collector death-surviving CPU accounting.  The
+    // measurement plane itself stays outside.
+    if (component != "trace-collector" &&
+        sns::JoinComponentCgroup(config_path, component))
+      SNS_LOG(sns::LogLevel::Info, component + " joined cpuacct cgroup");
     return sns::RunRole(component, cfg, argc, argv);
   } catch (const std::exception& e) {
     std::cerr << "fatal: " << e.what() << "\n";

@@ -223,6 +223,47 @@ def test_unregistered_burner_is_attributed_non_cooperatively(tmp_path):
     assert max(cpu) > 100.0, cpu            # millicores: ~1 core while burning
 
 
+def _cgroupfs_writable() -> bool:
+    # pid-suffixed: parallel pytest workers must not collide on the probe
+    probe = f"/sys/fs/cgroup/cpuacct/drft_probe_{os.getpid()}"
+    try:
+        os.mkdir(probe)
+        os.rmdir(probe)
+        return True
+    except OSError:
+        return False
+
+
+@needs_snsd
+@pytest.mark.slow
+@pytest.mark.skipif(not _cgroupfs_writable(),
+                    reason="no writable cgroupfs on this host")
+def test_short_lived_unregistered_burn_survives_process_death(tmp_path):
+    """A miner that starts AND dies between two scrapes leaves no process
+    for /proc sampling to find — only the cgroup counter, which survives
+    member death, can attribute it (cadvisor semantics; the cgroup tier in
+    collector.cpp).  2 s scrape window, 0.8 s burn."""
+    from deeprest_tpu.loadgen.client import chaos_burn
+
+    out = str(tmp_path / "cg.jsonl")
+    victim = "compose-post-service"
+    with SnsCluster(out_path=out, interval_ms=2000, grace_ms=200,
+                    chaos=True) as cluster:
+        time.sleep(2.2)                      # let the baseline scrape land
+        host, port = cluster.components[victim]
+        chaos_burn(host, port, seconds=0.8)  # dead well before next scrape
+        time.sleep(3.2)
+        cluster.stop(drain_s=0.5)
+    buckets = load_raw_data(out)
+    cpu = [m.value for b in buckets for m in b.metrics
+           if m.component == victim and m.resource == "cpu"]
+    # 0.8 s of burn inside a 2 s window ≈ 400 millicores unloaded; under CI
+    # contention the child may only get ~0.2 s of actual CPU.  The signal
+    # that matters: an idle service's buckets read < 5 mc, and the /proc
+    # fallback would read ~0 here (the pid is gone at scrape time).
+    assert max(cpu, default=0.0) > 50.0, cpu
+
+
 def test_register_with_collector_frame_format():
     """The framing must match native FramedSocket: 4-byte BE length + JSON."""
     import json
