@@ -242,6 +242,11 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
 def _run_child(extra_args: list[str], env_overrides: dict[str, str],
                timeout_s: float) -> dict:
     env = dict(os.environ)
+    # Persistent XLA compilation cache: the flagship step's 20-40s compile
+    # (longer at F=10240) is pure overhead on every re-run; jax keys cache
+    # entries by version/backend/flags so staleness is not a concern.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
     env.update(env_overrides)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--measure", *extra_args],

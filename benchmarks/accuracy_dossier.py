@@ -93,17 +93,28 @@ def generate_unseen_corpus(scenario, num_buckets: int, space, path: str):
     # tens of minutes.  Keyed on the full hash-space identity (capacity,
     # seed, mode) and only honored when NEWER than the corpus it was built
     # from — a regenerated jsonl must invalidate it.
+    # HASH mode only: a dict-mode space's column assignment depends on the
+    # learned vocabulary (which corpus trained it, in what order), which
+    # the key below cannot capture — caching it would silently misalign
+    # columns after a month-corpus regeneration.
     cfg = space.config
-    cache = (f"{path}.feat_c{cfg.capacity or 0}_s{cfg.hash_seed}"
-             f"{'_hash' if cfg.hash_features else '_dict'}.npz")
-    if os.path.exists(cache) and \
+    cache = (f"{path}.feat_c{cfg.capacity or 0}_s{cfg.hash_seed}_hash.npz"
+             if cfg.hash_features else None)
+    if cache and os.path.exists(cache) and \
             os.path.getmtime(cache) > os.path.getmtime(path):
-        z = np.load(cache)
-        keys = [str(k) for k in z["keys"]]
-        inv_names = [str(c) for c in z["inv_names"]]
-        invocations = {c: z["inv_values"][:, i]
-                       for i, c in enumerate(inv_names)}
-        return z["traffic"], z["metrics"], keys, invocations
+        try:
+            z = np.load(cache)
+            keys = [str(k) for k in z["keys"]]
+            inv_names = [str(c) for c in z["inv_names"]]
+            invocations = {c: z["inv_values"][:, i]
+                           for i, c in enumerate(inv_names)}
+            return z["traffic"], z["metrics"], keys, invocations
+        except Exception as exc:  # truncated/corrupt cache: refeaturize
+            print(f"featurize cache unreadable ({exc}); rebuilding")
+            try:
+                os.unlink(cache)
+            except OSError:
+                pass
     traffic_rows, metric_rows, keys = [], [], None
     inv_rows: list[dict[str, int]] = []
     for bucket in iter_raw_data_jsonl(path):
@@ -120,15 +131,21 @@ def generate_unseen_corpus(scenario, num_buckets: int, space, path: str):
     }
     traffic = np.stack(traffic_rows)
     metrics = np.stack(metric_rows)
-    try:
-        np.savez_compressed(
-            cache, traffic=traffic, metrics=metrics,
-            keys=np.array(keys),
-            inv_names=np.array(comps),
-            inv_values=np.stack([invocations[c] for c in comps], axis=-1)
-            if comps else np.zeros((len(traffic), 0), np.float32))
-    except OSError as exc:
-        print(f"featurize cache write failed (continuing): {exc}")
+    if cache:
+        try:
+            # tmp + rename: an interrupted save must not leave a truncated
+            # npz that is newer than the corpus (it would poison the mtime
+            # check on every later run).
+            tmp = cache + ".tmp.npz"
+            np.savez_compressed(
+                tmp, traffic=traffic, metrics=metrics,
+                keys=np.array(keys),
+                inv_names=np.array(comps),
+                inv_values=np.stack([invocations[c] for c in comps], axis=-1)
+                if comps else np.zeros((len(traffic), 0), np.float32))
+            os.replace(tmp, cache)
+        except OSError as exc:
+            print(f"featurize cache write failed (continuing): {exc}")
     return traffic, metrics, keys, invocations
 
 

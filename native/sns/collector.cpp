@@ -288,11 +288,19 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
                    ReadCgroupCpuNs(options_.config_path, component, &cg_ns);
       if (cg_ok) {
         auto prev_cg = last_cgroup_ns_.find(component);
-        if (prev_cg != last_cgroup_ns_.end() && dt > 0) {
-          push("cpu",
-               std::max(0.0, (cg_ns - prev_cg->second) / 1e9) / dt * 1000.0);
+        double cg_delta = prev_cg != last_cgroup_ns_.end()
+                              ? std::max(0.0, cg_ns - prev_cg->second)
+                              : -1.0;  // first sighting: baseline only
+        // Stale-dir guard: a leftover cgroup the service failed to JOIN
+        // (e.g. permissions) reads 0 forever while /proc shows real usage
+        // — the process cannot be in the cgroup if the cgroup advanced
+        // less than its own /proc tree, so trust /proc then.
+        if (cg_delta == 0.0 && have_delta && d_cpu > 0.0) {
+          push("cpu", d_cpu / dt * 1000.0);
+        } else if (cg_delta >= 0.0 && dt > 0) {
+          push("cpu", cg_delta / 1e9 / dt * 1000.0);
         } else {
-          push("cpu", 0.0);  // first sighting: baseline only
+          push("cpu", 0.0);
         }
         last_cgroup_ns_[component] = cg_ns;
       } else {

@@ -35,6 +35,33 @@ def test_chip_peak_lookup():
     assert bench.chip_peak_tflops("cpu") is None
 
 
+def test_last_good_snapshot_roundtrip(tmp_path, monkeypatch):
+    """A successful TPU result persists; a tunnel-down run loads it back
+    with the fields the degrade path embeds (value, MFU, sha, timestamp)."""
+    import bench
+
+    monkeypatch.setattr(bench, "LAST_GOOD_TPU",
+                        str(tmp_path / "last_good_tpu.json"))
+    monkeypatch.setattr(bench, "LAST_GOOD_FALLBACKS", ())
+    assert bench._load_last_good_tpu() is None      # nothing yet
+    result = {
+        "metric": "train_steps_per_sec", "value": 123.4,
+        "unit": "steps/s (tpu; ...)",
+        "perf": {"mfu_pct": 21.5, "sustained_tflops": 42.0,
+                 "chip": "TPU v5 lite"},
+        "tenk_endpoint": {"mfu_pct": 35.0},
+    }
+    bench._save_last_good_tpu(result)
+    snap = bench._load_last_good_tpu()
+    assert snap["steps_per_sec"] == 123.4
+    assert snap["mfu_pct"] == 21.5
+    assert snap["tenk_mfu_pct"] == 35.0
+    assert snap["recorded_utc"] and snap["source"].endswith(
+        "last_good_tpu.json")
+    # git_sha is best-effort but should resolve inside this repo
+    assert snap["git_sha"]
+
+
 def test_mfu_block_shape():
     measured = {"steps_per_sec": 100.0, "device_kind": "TPU v5 lite",
                 "model_state_bytes": 123}
