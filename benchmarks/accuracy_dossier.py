@@ -279,6 +279,13 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU run: small topology/corpus, proves the "
                          "pipeline, numbers are NOT the dossier")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend at FULL data scale — the "
+                         "honest fallback dossier when the TPU tunnel is "
+                         "down (meta.platform records it)")
+    ap.add_argument("--limit-buckets", type=int, default=None,
+                    help="use only the first N month buckets (with --cpu: "
+                         "bounds the train cost; full-feature width kept)")
     args = ap.parse_args()
 
     import jax
@@ -287,6 +294,8 @@ def main():
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")
         SVC, EP, F_CAP, N_METRICS = 12, 8, 256, 8
+    elif args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
     from deeprest_tpu.data.featurize import CallPathSpace, FeaturizedData
@@ -331,7 +340,15 @@ def main():
     metrics = data0.targets()
     keys, space = list(data0.metric_names), data0.space
     invocations = data0.invocations
+    # Metric selection runs on the FULL series even when --limit-buckets
+    # bounds the train cost: the fallback dossier must target the same
+    # metric set the full run would, or the two are not comparable.
     targets, metric_names = select_metrics(metrics, keys, N_METRICS)
+    if args.limit_buckets:
+        traffic = traffic[:args.limit_buckets]
+        targets = targets[:args.limit_buckets]
+        invocations = {c: v[:args.limit_buckets]
+                       for c, v in invocations.items()}
     print(f"corpus featurized: {traffic.shape} in {time.time()-t0:.0f}s",
           flush=True)
 
@@ -351,8 +368,9 @@ def main():
     cfg = Config(
         model=ModelConfig(feature_dim=feat_dim, num_metrics=len(metric_names),
                           hidden_size=128,
-                          compute_dtype="float32" if args.smoke
-                          else "bfloat16"),
+                          # bf16 is software-emulated on CPU (~10x slower)
+                          compute_dtype="bfloat16"
+                          if not (args.smoke or args.cpu) else "float32"),
         train=TrainConfig(batch_size=32, window_size=window,
                           num_epochs=epochs, log_every_steps=0, seed=0,
                           eval_stride=window),

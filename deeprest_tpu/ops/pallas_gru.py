@@ -37,9 +37,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import os as _os
+
 # Experts per kernel program: amortizes grid overhead while keeping
-# VMEM residency (W_hh alone is E_BLK * H * 3H * 4B).
-E_BLK = 8
+# VMEM residency (W_hh alone is E_BLK * H * 3H * 4B).  Env-overridable
+# (DEEPREST_GRU_E_BLK) so on-chip sweeps can A/B without code edits.
+E_BLK = int(_os.environ.get("DEEPREST_GRU_E_BLK", "8"))
 # Time steps per kernel program.  Each program advances the recurrence
 # T_BLK steps with the hidden state in VMEM scratch: fewer grid programs
 # and fewer (larger) DMA blocks.  Inside a program the loop runs
@@ -49,8 +52,8 @@ E_BLK = 8
 # shape (benchmarks/kernel_tuning.py): ~25% faster than T_BLK=1.
 # Callers pad T up to a multiple (pad_time); padded tail steps compute
 # garbage that is sliced off, which is safe because the tail is beyond
-# every real output in scan order.
-T_BLK = 6
+# every real output in scan order.  Env-overridable (DEEPREST_GRU_T_BLK).
+T_BLK = int(_os.environ.get("DEEPREST_GRU_T_BLK", "6"))
 # f32 sublane granularity — batch is padded up to this.
 _SUBLANE = 8
 

@@ -58,8 +58,9 @@ def test_last_good_snapshot_roundtrip(tmp_path, monkeypatch):
     assert snap["tenk_mfu_pct"] == 35.0
     assert snap["recorded_utc"] and snap["source"].endswith(
         "last_good_tpu.json")
-    # git_sha is best-effort but should resolve inside this repo
-    assert snap["git_sha"]
+    # git_sha is best-effort (None without a .git dir or git binary);
+    # the field must exist either way
+    assert "git_sha" in snap
 
 
 def test_mfu_block_shape():
