@@ -52,10 +52,56 @@ E_BLK = int(_os.environ.get("DEEPREST_GRU_E_BLK", "8"))
 # shape (benchmarks/kernel_tuning.py): ~25% faster than T_BLK=1.
 # Callers pad T up to a multiple (pad_time); padded tail steps compute
 # garbage that is sliced off, which is safe because the tail is beyond
-# every real output in scan order.  Env-overridable (DEEPREST_GRU_T_BLK).
-T_BLK = int(_os.environ.get("DEEPREST_GRU_T_BLK", "6"))
+# every real output in scan order.  Env-overridable (DEEPREST_GRU_T_BLK;
+# clamped to ≥1 — 0 would divide-by-zero pad_time and empty the chooser's
+# candidate list).
+T_BLK = max(1, int(_os.environ.get("DEEPREST_GRU_T_BLK", "6")))
 # f32 sublane granularity — batch is padded up to this.
 _SUBLANE = 8
+# Scoped-VMEM budget for one kernel program's blocks (the hardware limit
+# is 16 MiB; headroom covers in-kernel temporaries the block math below
+# cannot see).  Blocks indexed by the sequential time grid are double-
+# buffered by the pallas pipeline and count twice.
+_VMEM_BUDGET = int(_os.environ.get("DEEPREST_GRU_VMEM_BUDGET",
+                                   str(14 << 20)))
+
+
+def _choose_blocks(e: int, t: int, per_expert_bytes) -> tuple[int, int]:
+    """Pick (e_blk, t_blk) whose block footprint fits the scoped-VMEM
+    budget.
+
+    The f32 backward kernel at the default E_BLK=8/T_BLK=6 needs ~18 MB
+    of double-buffered blocks — over the chip's 16 MiB scoped-VMEM limit
+    (observed on v5e as a hard compile OOM) — while the bf16 production
+    path fits.  The expert axis is the sublane of the 2-D f32 bias
+    blocks, so pallas requires e_blk % 8 == 0 (or e_blk == e); the time
+    axis is grid-leading and unconstrained, so VMEM pressure is relieved
+    by shrinking t_blk.  ``per_expert_bytes`` maps t_blk → bytes per
+    expert.  Correctness is unaffected (experts independent; the kernels
+    carry hidden state across time blocks in scratch)."""
+    legal_e = [c for c in range(_SUBLANE, e + 1, _SUBLANE)
+               if e % c == 0 and c <= E_BLK] or [e]
+    if E_BLK % _SUBLANE and E_BLK < e:
+        import warnings
+
+        warnings.warn(
+            f"DEEPREST_GRU_E_BLK={E_BLK} is not a multiple of {_SUBLANE} "
+            f"(the sublane of the 2-D f32 bias blocks) — pallas cannot "
+            f"tile it; using e_blk={legal_e[-1]} instead", stacklevel=3)
+    t_candidates = [c for c in range(min(T_BLK, t), 0, -1) if t % c == 0]
+    # Prefer the widest expert block; shrink time first, then experts.
+    for e_blk in reversed(legal_e):
+        for t_blk in t_candidates:
+            if e_blk * per_expert_bytes(t_blk) <= _VMEM_BUDGET:
+                return e_blk, t_blk
+    import warnings
+
+    warnings.warn(
+        f"GRU kernel block footprint exceeds the scoped-VMEM budget even "
+        f"at ({legal_e[0]}, 1) — compile may OOM; raise "
+        f"DEEPREST_GRU_VMEM_BUDGET only if the chip allows it",
+        stacklevel=3)
+    return legal_e[0], t_candidates[-1]
 
 
 def _gates(xproj, gates_h):
@@ -112,19 +158,25 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret):
     e, t, b, g3 = proj.shape
     h = g3 // 3
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
-    eb = e // E_BLK if e % E_BLK == 0 else 1
-    e_blk = e // eb
-    grid = (eb, t // T_BLK)
+    io = proj.dtype.itemsize
+    per_expert = lambda t_blk: (
+        2 * (t_blk * b * g3 * io + t_blk * b * h * 4)   # proj in + out, 2-buf
+        + h * g3 * w_hh.dtype.itemsize + g3 * 4          # W_hh, b_hh resident
+        + b * h * h0.dtype.itemsize + b * h * 4          # h0 block + scratch
+    )
+    e_blk, t_blk = _choose_blocks(e, t, per_expert)
+    eb = e // e_blk
+    grid = (eb, t // t_blk)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((e_blk, T_BLK, b, g3), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((e_blk, t_blk, b, g3), lambda i, j: (i, j, 0, 0)),
             pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
             pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((e_blk, T_BLK, b, h), lambda i, j: (i, j, 0, 0)),
+        out_specs=pl.BlockSpec((e_blk, t_blk, b, h), lambda i, j: (i, j, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((e, t, b, h), jnp.float32),
         scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -209,23 +261,34 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     e, t, b, g3 = proj.shape
     h = g3 // 3
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
-    eb = e // E_BLK if e % E_BLK == 0 else 1
-    e_blk = e // eb
-    nb = t // T_BLK
+    io = proj.dtype.itemsize
+    per_expert = lambda t_blk: (
+        # time-grid blocks, double-buffered: proj, h_prev, dout in;
+        # dproj out (h_prev_all and dout arrive f32 — see _vjp_bwd)
+        2 * (t_blk * b * g3 * io + 2 * t_blk * b * h * 4
+             + t_blk * b * g3 * io)
+        # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch
+        + h * g3 * w_hh.dtype.itemsize + g3 * 4
+        + h * g3 * 4 + g3 * 4 + b * h * 4
+        + b * h * 4 + h * g3 * 4 + g3 * 4
+    )
+    e_blk, t_blk = _choose_blocks(e, t, per_expert)
+    eb = e // e_blk
+    nb = t // t_blk
     grid = (eb, nb)
     rev = lambda i, j: (i, nb - 1 - j, 0, 0)  # walk time blocks back-to-front
     dproj, dw, db, dh0 = pl.pallas_call(
         functools.partial(_bwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((e_blk, T_BLK, b, g3), rev),
-            pl.BlockSpec((e_blk, T_BLK, b, h), rev),
+            pl.BlockSpec((e_blk, t_blk, b, g3), rev),
+            pl.BlockSpec((e_blk, t_blk, b, h), rev),
             pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
-            pl.BlockSpec((e_blk, T_BLK, b, h), rev),
+            pl.BlockSpec((e_blk, t_blk, b, h), rev),
         ],
         out_specs=[
-            pl.BlockSpec((e_blk, T_BLK, b, g3), rev),
+            pl.BlockSpec((e_blk, t_blk, b, g3), rev),
             pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
             pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),

@@ -189,3 +189,33 @@ def test_unsupported_hidden_falls_back_to_scan():
     out = gru(params, x, backend="pallas_interpret")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_vmem_budget_shrinks_time_block(monkeypatch):
+    """When the block footprint would exceed the scoped-VMEM budget, the
+    chooser shrinks the TIME block (the expert block is sublane-pinned to
+    multiples of 8) — numerics must be unchanged.  A tiny budget forces
+    the smallest blocking; this is the regression test for the f32
+    backward kernel OOM observed on v5e (see PERF.md, round 4)."""
+    from deeprest_tpu.ops import pallas_gru
+
+    params, x, _ = _setup(t=12)
+
+    def loss(backend, x):
+        fwd = gru(params, x, backend=backend)
+        rev = gru(params, x, reverse=True, backend=backend)
+        return jnp.sum(fwd ** 2) + jnp.sum(jnp.sin(rev))
+
+    ref_l = float(loss("scan", x))
+    g_ref = jax.grad(lambda x: loss("scan", x))(x)
+
+    monkeypatch.setattr(pallas_gru, "_VMEM_BUDGET", 1)
+    e_blk, t_blk = pallas_gru._choose_blocks(8, 12, lambda t: t * 10_000)
+    assert t_blk == 1 and e_blk == 8      # shrank time, kept sublane-legal E
+
+    np.testing.assert_allclose(float(loss("pallas_interpret", x)), ref_l,
+                               rtol=1e-5)
+    g_pl = jax.grad(lambda x: loss("pallas_interpret", x))(x)
+    np.testing.assert_allclose(np.asarray(g_pl), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-4)
