@@ -241,18 +241,24 @@ class SnsCluster:
         self._procs.clear()
         self._remove_cgroups()
 
-    def _remove_cgroups(self) -> None:
-        """Best-effort rmdir of this cluster's per-component cpuacct
-        cgroups (services self-placed into them at startup; a cgroup dir
-        is only removable once empty, i.e. after every member exited).
-        Same FNV-1a64(config_path) naming as native/sns/common.cpp."""
-        if not self._config_path:
-            return
+    def cgroup_dir(self, component: str) -> str:
+        """This cluster's cpuacct cgroup directory for ``component`` —
+        the same FNV-1a64(config_path) naming native/sns/common.cpp
+        ComponentCgroupDir uses (the single Python mirror of that
+        scheme; _remove_cgroups and tests both go through here)."""
+        assert self._config_path, "cluster not started"
         h = 0xCBF29CE484222325
         for b in self._config_path.encode():
             h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
-        base = "/sys/fs/cgroup/cpuacct/deeprest"
-        prefix = f"{h:016x}_"
+        return f"/sys/fs/cgroup/cpuacct/deeprest/{h:016x}_{component}"
+
+    def _remove_cgroups(self) -> None:
+        """Best-effort rmdir of this cluster's per-component cpuacct
+        cgroups (services self-placed into them at startup; a cgroup dir
+        is only removable once empty, i.e. after every member exited)."""
+        if not self._config_path:
+            return
+        base, prefix = os.path.split(self.cgroup_dir(""))
         try:
             names = os.listdir(base)
         except OSError:

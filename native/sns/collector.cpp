@@ -21,6 +21,7 @@
 #include <fstream>
 #include <functional>
 #include <map>
+#include <set>
 #include <sstream>
 #include <unordered_map>
 #include <vector>
@@ -257,24 +258,34 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
       auto& prev_map = last_samples_[component];
       const bool first_scrape = prev_map.empty();
       std::map<int, ProcSample> now_map;
-      if (pid > 0) {
-        for (int p : ProcessTree(pid)) {
-          ProcSample s = ReadProc(p);
-          if (!s.ok) continue;
-          any_ok = true;
-          now_map[p] = s;
-          rss += s.rss_mb;
-          auto it = prev_map.find(p);
-          if (it != prev_map.end() && it->second.ok) {
-            d_cpu += std::max(0.0, s.cpu_seconds - it->second.cpu_seconds);
-            d_wb += std::max(0.0, s.write_bytes - it->second.write_bytes);
-            d_wsc +=
-                std::max(0.0, s.write_syscalls - it->second.write_syscalls);
-          } else if (!first_scrape) {
-            d_cpu += s.cpu_seconds;
-            d_wb += s.write_bytes;
-            d_wsc += s.write_syscalls;
-          }
+      // Sampled pids = the registered pid's process tree ∪ the
+      // component cgroup's members: io/memory for a FOREIGN process
+      // placed in the cgroup (a datastore the framework didn't write, a
+      // daemonized miner) is attributed by membership, like the cpuacct
+      // counter already is — attribution a process cannot opt out of by
+      // detaching from the service's process tree.
+      std::set<int> sampled;
+      if (pid > 0)
+        for (int p : ProcessTree(pid)) sampled.insert(p);
+      if (!options_.config_path.empty())
+        for (int p : CgroupProcs(options_.config_path, component))
+          sampled.insert(p);
+      for (int p : sampled) {
+        ProcSample s = ReadProc(p);
+        if (!s.ok) continue;
+        any_ok = true;
+        now_map[p] = s;
+        rss += s.rss_mb;
+        auto it = prev_map.find(p);
+        if (it != prev_map.end() && it->second.ok) {
+          d_cpu += std::max(0.0, s.cpu_seconds - it->second.cpu_seconds);
+          d_wb += std::max(0.0, s.write_bytes - it->second.write_bytes);
+          d_wsc +=
+              std::max(0.0, s.write_syscalls - it->second.write_syscalls);
+        } else if (!first_scrape) {
+          d_cpu += s.cpu_seconds;
+          d_wb += s.write_bytes;
+          d_wsc += s.write_syscalls;
         }
       }
       const bool have_delta = any_ok && !first_scrape && dt > 0;

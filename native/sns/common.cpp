@@ -381,6 +381,21 @@ bool ReadCgroupCpuNs(const std::string& config_path,
   return true;
 }
 
+std::vector<int> CgroupProcs(const std::string& config_path,
+                             const std::string& component) {
+  // Every pid currently in the component's cgroup — including processes
+  // the framework did not spawn (a foreign datastore, a daemonized
+  // miner).  This is the io/memory analogue of the cpuacct counter:
+  // membership, not ancestry, decides attribution, so a process cannot
+  // opt out by detaching from the service's process tree.
+  std::vector<int> pids;
+  std::ifstream f(ComponentCgroupDir(config_path, component) +
+                  "/cgroup.procs");
+  int pid;
+  while (f >> pid) pids.push_back(pid);
+  return pids;
+}
+
 RpcServer::RpcServer(std::string component, int port)
     : component_(std::move(component)), port_(port) {
   // Fault-injection surface (SURVEY.md §5.3), gated behind DEEPREST_CHAOS:

@@ -62,6 +62,9 @@ bool JoinComponentCgroup(const std::string& config_path,
 // living and dead); returns false when the cgroup is absent/unreadable.
 bool ReadCgroupCpuNs(const std::string& config_path,
                      const std::string& component, double* out_ns);
+// Pids currently in the component's cgroup (empty when absent/unreadable).
+std::vector<int> CgroupProcs(const std::string& config_path,
+                             const std::string& component);
 
 // ---------------------------------------------------------------------------
 // Sockets + framed transport

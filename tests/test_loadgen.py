@@ -383,3 +383,52 @@ def test_gateway_serves_browsable_pages(tmp_path):
             assert False, "media-frontend should not serve pages"
         except urllib.error.HTTPError as e:
             assert e.code in (404, 500)
+
+
+@needs_snsd
+@pytest.mark.slow
+@pytest.mark.skipif(not _cgroupfs_writable(),
+                    reason="no writable cgroupfs on this host")
+def test_foreign_process_in_cgroup_gets_io_attribution(tmp_path):
+    """The reference measures anything on a PVC from outside the process
+    (OpenEBS exporters / cadvisor — minikube-openebs/monitor-openebs-pg.yaml);
+    our analogue: a process the framework did NOT spawn, placed in a store
+    component's cgroup by the operator, is sampled by cgroup MEMBERSHIP
+    (collector.cpp CgroupProcs) — not process-tree ancestry — so its
+    write-iops/write-tp land on that component."""
+    import subprocess
+    import sys
+
+    out = str(tmp_path / "foreign_io.jsonl")
+    victim = "post-storage-mongodb"
+    with SnsCluster(out_path=out, interval_ms=1000, grace_ms=200) as cluster:
+        cgdir = cluster.cgroup_dir(victim)
+        assert os.path.isdir(cgdir), "service did not join its cgroup"
+        # The foreign writer: child of PYTEST, not of any snsd process —
+        # the process-tree sampler structurally cannot see it.
+        writer = subprocess.Popen(
+            [sys.executable, "-c", (
+                "import os, time\n"
+                "end = time.time() + 6.0\n"
+                "fd = os.open('foreign.dat', os.O_WRONLY | os.O_CREAT, 0o600)\n"
+                "blob = b'x' * (1 << 20)\n"
+                "while time.time() < end:\n"
+                "    os.pwrite(fd, blob, 0)\n"
+                "    os.fsync(fd)\n"
+                "    time.sleep(0.05)\n"
+            )], cwd=str(tmp_path))
+        try:
+            with open(os.path.join(cgdir, "cgroup.procs"), "w",
+                      encoding="ascii") as f:
+                f.write(str(writer.pid))
+            time.sleep(4.5)              # several 1 s scrapes with deltas
+        finally:
+            writer.terminate()
+            writer.wait()
+        cluster.stop(drain_s=1.0)
+    buckets = load_raw_data(out)
+    wtp = [m.value for b in buckets for m in b.metrics
+           if m.component == victim and m.resource == "write-tp"]
+    # ~1 MB fsync'd every 50 ms ≈ 20 MB/s; an idle store writes ~0.  Even
+    # under heavy CI contention a window should catch >100 KB/s.
+    assert max(wtp, default=0.0) > 100.0, wtp
