@@ -93,6 +93,7 @@ ProcSample ReadProc(int pid) {
   }
   {
     std::ifstream f("/proc/" + std::to_string(pid) + "/io");
+    s.io_ok = static_cast<bool>(f);
     std::string line;
     while (std::getline(f, line)) {
       if (line.rfind("write_bytes:", 0) == 0)
@@ -264,9 +265,10 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
       // daemonized miner) is attributed by membership, like the cpuacct
       // counter already is — attribution a process cannot opt out of by
       // detaching from the service's process tree.
-      std::set<int> sampled;
+      std::set<int> tree_pids;
       if (pid > 0)
-        for (int p : ProcessTree(pid)) sampled.insert(p);
+        for (int p : ProcessTree(pid)) tree_pids.insert(p);
+      std::set<int> sampled = tree_pids;
       if (!options_.config_path.empty())
         for (int p : CgroupProcs(options_.config_path, component))
           sampled.insert(p);
@@ -276,13 +278,28 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
         any_ok = true;
         now_map[p] = s;
         rss += s.rss_mb;
+        if (!s.io_ok && !warned_io_unreadable_.count(p) &&
+            !StoreKindFor(component).empty()) {
+          warned_io_unreadable_.insert(p);
+          SNS_LOG(LogLevel::Warning,
+                  "pid " + std::to_string(p) + " in " + component +
+                      ": /proc io unreadable (foreign uid?) — write "
+                      "metrics will undercount this member");
+        }
         auto it = prev_map.find(p);
         if (it != prev_map.end() && it->second.ok) {
           d_cpu += std::max(0.0, s.cpu_seconds - it->second.cpu_seconds);
           d_wb += std::max(0.0, s.write_bytes - it->second.write_bytes);
           d_wsc +=
               std::max(0.0, s.write_syscalls - it->second.write_syscalls);
-        } else if (!first_scrape) {
+        } else if (!first_scrape && tree_pids.count(p)) {
+          // A pid first seen INSIDE the process tree was born after the
+          // previous scrape, so its whole cumulative usage is in-window.
+          // That inference is wrong for a pid that arrived by cgroup
+          // MEMBERSHIP: an operator can move a long-running process (50 GB
+          // of lifetime write_bytes) into the cgroup mid-run, and dumping
+          // its lifetime counters into one bucket would corrupt the
+          // series — first sighting is baseline-only for those.
           d_cpu += s.cpu_seconds;
           d_wb += s.write_bytes;
           d_wsc += s.write_syscalls;

@@ -2,6 +2,7 @@
 
 #include <atomic>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -29,6 +30,11 @@ struct ProcSample {
   double write_bytes = 0;     // cumulative
   double write_syscalls = 0;  // cumulative
   bool ok = false;
+  // /proc/<pid>/io is ptrace-gated: readable for own-uid/root only.  A
+  // foreign-uid cgroup member samples cpu/rss fine while its io reads 0 —
+  // distinguished here so the collector can WARN instead of silently
+  // reporting zero write metrics for exactly the foreign-datastore case.
+  bool io_ok = false;
 };
 
 struct PendingTrace {
@@ -64,6 +70,9 @@ class Collector {
   // component -> last cumulative cgroup cpuacct.usage (preferred CPU
   // source: survives child death, counts every process in the cgroup).
   std::map<std::string, double> last_cgroup_ns_;
+  // pids already warned about unreadable /proc/<pid>/io (one line per pid,
+  // not one per scrape).
+  std::set<int> warned_io_unreadable_;
   // live observability state (all guarded by mu_)
   std::map<std::pair<std::string, std::string>, double> latest_;
   uint64_t spans_ingested_ = 0;

@@ -11,6 +11,18 @@ import os
 # TPU) and the axon site hook re-exports it, so the env var alone is not
 # enough — force the platform through jax.config before any backend init.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop the axon plugin's site dir entirely: its import-time hook talks to
+# the TPU tunnel's local relay, and when the tunnel is wedged (observed
+# for hours at a stretch) that BLOCKS `import jax` — hanging the whole
+# CPU-only suite on a machine whose TPU it never uses.
+import sys
+
+_axon_site = "/root/.axon_site"
+sys.path[:] = [p for p in sys.path if _axon_site not in p]
+if _axon_site in os.environ.get("PYTHONPATH", ""):
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ["PYTHONPATH"].split(os.pathsep)
+        if p and _axon_site not in p)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
