@@ -71,12 +71,26 @@ ProcSample ReadProc(int pid) {
     if (paren == std::string::npos) return s;
     std::istringstream rest(line.substr(paren + 2));
     std::string tok;
-    // After comm: state(1) then fields 4..; utime is field 14, stime 15.
+    // After comm: state(1) then fields 4..; utime is field 14, stime 15,
+    // starttime (ticks since boot) is field 22.
     std::vector<std::string> toks;
     while (rest >> tok) toks.push_back(tok);
     if (toks.size() < 13) return s;
+    double hz = sysconf(_SC_CLK_TCK);
     double ticks = std::stod(toks[11]) + std::stod(toks[12]);
-    s.cpu_seconds = ticks / sysconf(_SC_CLK_TCK);
+    s.cpu_seconds = ticks / hz;
+    if (toks.size() >= 20) {
+      static const double btime = [] {
+        // /proc/stat btime: boot as unix time — converts starttime's
+        // ticks-since-boot into an epoch comparable with scrape times.
+        std::ifstream st("/proc/stat");
+        std::string l;
+        while (std::getline(st, l))
+          if (l.rfind("btime ", 0) == 0) return std::stod(l.substr(6));
+        return 0.0;
+      }();
+      if (btime > 0) s.start_epoch_s = btime + std::stod(toks[19]) / hz;
+    }
   }
   {
     std::ifstream f("/proc/" + std::to_string(pid) + "/status");
@@ -265,10 +279,9 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
       // daemonized miner) is attributed by membership, like the cpuacct
       // counter already is — attribution a process cannot opt out of by
       // detaching from the service's process tree.
-      std::set<int> tree_pids;
+      std::set<int> sampled;
       if (pid > 0)
-        for (int p : ProcessTree(pid)) tree_pids.insert(p);
-      std::set<int> sampled = tree_pids;
+        for (int p : ProcessTree(pid)) sampled.insert(p);
       if (!options_.config_path.empty())
         for (int p : CgroupProcs(options_.config_path, component))
           sampled.insert(p);
@@ -292,14 +305,14 @@ Json Collector::CutBucket(uint64_t t0_ns, uint64_t t1_ns, uint64_t grace_ns) {
           d_wb += std::max(0.0, s.write_bytes - it->second.write_bytes);
           d_wsc +=
               std::max(0.0, s.write_syscalls - it->second.write_syscalls);
-        } else if (!first_scrape && tree_pids.count(p)) {
-          // A pid first seen INSIDE the process tree was born after the
-          // previous scrape, so its whole cumulative usage is in-window.
-          // That inference is wrong for a pid that arrived by cgroup
-          // MEMBERSHIP: an operator can move a long-running process (50 GB
-          // of lifetime write_bytes) into the cgroup mid-run, and dumping
-          // its lifetime counters into one bucket would corrupt the
-          // series — first sighting is baseline-only for those.
+        } else if (!first_scrape && s.start_epoch_s * 1e9 > t0_ns) {
+          // First sighting: attribute the whole cumulative counters ONLY
+          // when /proc says the process STARTED inside this scrape window
+          // (verified via starttime, not inferred from tree membership).
+          // A newborn's lifetime is entirely in-window whether it arrived
+          // by fork or by cgroup placement; a long-running process moved
+          // into the cgroup mid-run (hours of CPU, GBs of write_bytes)
+          // baselines instead of corrupting one bucket with its lifetime.
           d_cpu += s.cpu_seconds;
           d_wb += s.write_bytes;
           d_wsc += s.write_syscalls;

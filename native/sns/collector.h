@@ -29,6 +29,7 @@ struct ProcSample {
   double rss_mb = 0;
   double write_bytes = 0;     // cumulative
   double write_syscalls = 0;  // cumulative
+  double start_epoch_s = 0;   // process start as unix time (btime+starttime)
   bool ok = false;
   // /proc/<pid>/io is ptrace-gated: readable for own-uid/root only.  A
   // foreign-uid cgroup member samples cpu/rss fine while its io reads 0 —

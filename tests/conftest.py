@@ -17,7 +17,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # CPU-only suite on a machine whose TPU it never uses.
 import sys
 
-_axon_site = "/root/.axon_site"
+_axon_site = os.environ.get("DEEPREST_AXON_SITE", "/root/.axon_site")
 sys.path[:] = [p for p in sys.path if _axon_site not in p]
 if _axon_site in os.environ.get("PYTHONPATH", ""):
     os.environ["PYTHONPATH"] = os.pathsep.join(
