@@ -193,7 +193,7 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret):
 
 def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
                 dproj_ref, dw_ref, db_ref, dh0_ref,
-                dh_scr, dw_scr, db_scr, *, dot_dtype):
+                dh_scr, dw_scr, db_scr, dg_scr, *, dot_dtype):
     t = pl.program_id(1)
     t_total = pl.num_programs(1)
 
@@ -207,7 +207,6 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
     ws = [w_ref[i].astype(dot_dtype) for i in range(n_e)]
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
     dhs = [dh_scr[i] for i in range(n_e)]
-    dws = [dw_scr[i] for i in range(n_e)]
     dbs = [db_scr[i] for i in range(n_e)]
     for tt in reversed(range(t_blk)):  # time OUTER, back-to-front in-block
         for i in range(n_e):           # experts INNER: independent matmuls
@@ -238,16 +237,24 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
                 dgates_h.astype(dot_dtype), ws[i], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            # dW_hh += h_prevᵀ @ dgates_h   (contract the batch axis)
-            dws[i] = dws[i] + jax.lax.dot_general(
-                h_prev.astype(dot_dtype), dgates_h.astype(dot_dtype),
-                (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+            # Stash dgates for the block-batched dW dot below.  In the
+            # bf16 path this is the SAME quantization the old per-step dW
+            # dot applied (dgates were cast to the dot dtype anyway).
+            dg_scr[i, tt] = dgates_h.astype(dg_scr.dtype)
             dbs[i] = dbs[i] + jnp.sum(dgates_h, axis=0)
     for i in range(n_e):
+        # dW_hh += h_prevᵀ @ dgates, contracted over the WHOLE time block
+        # (K = t_blk·B instead of B): one MXU dot per block instead of one
+        # per step — ~t_blk× fewer dW dispatches at far better systolic
+        # occupancy; algebraically the same sum, reassociated.
+        h_flat = hprev_ref[i].astype(dot_dtype).reshape(
+            -1, hprev_ref.shape[-1])
+        g_flat = dg_scr[i].reshape(-1, dg_scr.shape[-1])
+        dw_scr[i] = dw_scr[i] + jax.lax.dot_general(
+            h_flat, g_flat, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         dh_scr[i] = dhs[i]
-        dw_scr[i] = dws[i]
         db_scr[i] = dbs[i]
 
     @pl.when(t == t_total - 1)  # last grid step == time 0: flush accumulators
@@ -262,15 +269,18 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     h = g3 // 3
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
     io = proj.dtype.itemsize
+    dot_io = jnp.dtype(_dot_dtype_for(proj.dtype)).itemsize
     per_expert = lambda t_blk: (
         # time-grid blocks, double-buffered: proj, h_prev, dout in;
         # dproj out (h_prev_all and dout arrive f32 — see _vjp_bwd)
         2 * (t_blk * b * g3 * io + 2 * t_blk * b * h * 4
              + t_blk * b * g3 * io)
-        # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch
+        # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch,
+        # dgates stash (dot dtype) for the block-batched dW dot
         + h * g3 * w_hh.dtype.itemsize + g3 * 4
         + h * g3 * 4 + g3 * 4 + b * h * 4
         + b * h * 4 + h * g3 * 4 + g3 * 4
+        + t_blk * b * g3 * dot_io
     )
     e_blk, t_blk = _choose_blocks(e, t, per_expert)
     eb = e // e_blk
@@ -303,6 +313,7 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
             pltpu.VMEM((e_blk, b, h), jnp.float32),
             pltpu.VMEM((e_blk, h, g3), jnp.float32),
             pltpu.VMEM((e_blk, g3), jnp.float32),
+            pltpu.VMEM((e_blk, t_blk, b, g3), _dot_dtype_for(proj.dtype)),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
