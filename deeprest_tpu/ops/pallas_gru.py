@@ -119,7 +119,16 @@ def _gates(xproj, gates_h):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr, *, dot_dtype):
+def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, *refs, dot_dtype, emit_prev):
+    # Training (emit_prev=True) also streams out the PRE-update hidden
+    # state per step: the VJP consumes h_prev directly instead of
+    # re-materializing it outside the kernel as concat(h0, h_all[:-1]) —
+    # one full [E,T,B,H] HBM round-trip saved per step.
+    if emit_prev:
+        out_ref, prev_ref, h_scr = refs
+    else:
+        out_ref, h_scr = refs
+        prev_ref = None
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -132,6 +141,8 @@ def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr, *, dot_dtype):
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
     for tt in range(t_blk):           # time OUTER
         for i in range(n_e):          # experts INNER: independent matmuls
+            if prev_ref is not None:
+                prev_ref[i, tt] = hs[i].astype(prev_ref.dtype)
             gates_h = (
                 jax.lax.dot_general(hs[i].astype(dot_dtype), ws[i],
                                     (((1,), (0,)), ((), ())),
@@ -154,21 +165,44 @@ def _dot_dtype_for(proj_dtype):
     return jnp.bfloat16 if proj_dtype == jnp.bfloat16 else jnp.float32
 
 
-def _fwd_call(proj, w_hh, b_hh, h0, interpret):
+def _out_dtype_for(proj_dtype):
+    """Hidden-state STORAGE dtype: bf16 models stream h in bf16 (the model
+    casts h_all to its own dtype right after the kernel anyway — f32
+    storage only doubled the largest HBM stream); f32 models stay exact.
+
+    Currently coincides with _dot_dtype_for (matmul precision), but the
+    two are distinct knobs: storage feeds the VJP's h_prev residual — and
+    the _bwd_call byte accounting — while the dot dtype only picks the
+    MXU path.  Change one without the other deliberately, not by drift.
+    Accepted approximation for bf16 models: the backward's dz term
+    (dh·(h_prev − n)) sees bf16-rounded h_prev where it previously saw
+    the exact f32 carry — ~2^-9 relative, inside the bf16 training noise
+    floor, and covered by the bf16 grad-parity test tolerances."""
+    return jnp.bfloat16 if proj_dtype == jnp.bfloat16 else jnp.float32
+
+
+def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
     e, t, b, g3 = proj.shape
     h = g3 // 3
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
     io = proj.dtype.itemsize
+    out_dtype = _out_dtype_for(proj.dtype)
+    oo = jnp.dtype(out_dtype).itemsize
+    n_out = 2 if emit_prev else 1
     per_expert = lambda t_blk: (
-        2 * (t_blk * b * g3 * io + t_blk * b * h * 4)   # proj in + out, 2-buf
+        # proj in + out (and prev out when training), double-buffered
+        2 * (t_blk * b * g3 * io + n_out * t_blk * b * h * oo)
         + h * g3 * w_hh.dtype.itemsize + g3 * 4          # W_hh, b_hh resident
         + b * h * h0.dtype.itemsize + b * h * 4          # h0 block + scratch
     )
     e_blk, t_blk = _choose_blocks(e, t, per_expert)
     eb = e // e_blk
     grid = (eb, t // t_blk)
+    out_spec = pl.BlockSpec((e_blk, t_blk, b, h), lambda i, j: (i, j, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((e, t, b, h), out_dtype)
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
+        functools.partial(_fwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype),
+                          emit_prev=emit_prev),
         grid=grid,
         in_specs=[
             pl.BlockSpec((e_blk, t_blk, b, g3), lambda i, j: (i, j, 0, 0)),
@@ -176,8 +210,8 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret):
             pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
             pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((e_blk, t_blk, b, h), lambda i, j: (i, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((e, t, b, h), jnp.float32),
+        out_specs=[out_spec] * n_out if emit_prev else out_spec,
+        out_shape=[out_shape] * n_out if emit_prev else out_shape,
         scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
@@ -270,10 +304,12 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
     io = proj.dtype.itemsize
     dot_io = jnp.dtype(_dot_dtype_for(proj.dtype)).itemsize
+    hp_io = h_prev_all.dtype.itemsize
+    do_io = dout.dtype.itemsize
     per_expert = lambda t_blk: (
         # time-grid blocks, double-buffered: proj, h_prev, dout in;
-        # dproj out (h_prev_all and dout arrive f32 — see _vjp_bwd)
-        2 * (t_blk * b * g3 * io + 2 * t_blk * b * h * 4
+        # dproj out (h_prev/dout ride the model's out dtype — _vjp_bwd)
+        2 * (t_blk * b * g3 * io + t_blk * b * h * (hp_io + do_io)
              + t_blk * b * g3 * io)
         # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch,
         # dgates stash (dot dtype) for the block-batched dW dot
@@ -344,23 +380,29 @@ def gru_recurrence(proj, w_hh, b_hh, h0, interpret=False):
       h0: ``[E, B, H]`` initial hidden state.
       interpret: run the pallas kernels in interpret mode (CPU testing).
 
-    Returns: ``[E, T, B, H]`` float32 hidden states.
+    Returns: ``[E, T, B, H]`` hidden states — f32 for f32 models, bf16 for
+    bf16 models (_out_dtype_for: the model casts to its own dtype right
+    after the kernel anyway, and f32 storage doubled the largest stream).
     """
     return _fwd_call(proj, w_hh, b_hh, h0, interpret)
 
 
 def _vjp_fwd(proj, w_hh, b_hh, h0, interpret):
-    h_all = _fwd_call(proj, w_hh, b_hh, h0, interpret)
-    return h_all, (proj, w_hh, b_hh, h0, h_all)
+    # Training forward streams h_prev out of the kernel directly — the
+    # backward consumes it without the concat(h0, h_all[:-1]) round-trip,
+    # and h_all itself is NOT a residual (the recompute needs only
+    # h_prev).  h0 rides along for its dtype/shape (tiny next to the
+    # [E,T,B,H] stash this replaces).
+    h_all, h_prev_all = _fwd_call(proj, w_hh, b_hh, h0, interpret,
+                                  emit_prev=True)
+    return h_all, (proj, w_hh, b_hh, h0, h_prev_all)
 
 
 def _vjp_bwd(interpret, res, dout):
-    proj, w_hh, b_hh, h0, h_all = res
-    h_prev_all = jnp.concatenate(
-        [h0[:, None].astype(h_all.dtype), h_all[:, :-1]], axis=1
-    )
+    proj, w_hh, b_hh, h0, h_prev_all = res
     dproj, dw, db, dh0 = _bwd_call(
-        proj, h_prev_all, w_hh, b_hh, dout.astype(jnp.float32), interpret
+        proj, h_prev_all, w_hh, b_hh,
+        dout.astype(_out_dtype_for(proj.dtype)), interpret
     )
     return (dproj, dw.astype(w_hh.dtype), db.astype(b_hh.dtype),
             dh0.astype(h0.dtype))
