@@ -111,6 +111,33 @@ class ResourceAwareBaseline:
         return params
 
 
+def component_scaling_fit(inv_train: np.ndarray,
+                          metric_train: np.ndarray) -> tuple:
+    """The reference's four scaling weights from a train split
+    (reference: baselines.py:97-104): min invocations, metric range,
+    invocation range, metric floor."""
+    return (
+        float(np.min(inv_train)),
+        float(np.max(metric_train) - np.min(metric_train)),
+        float(np.max(inv_train) - np.min(inv_train)),
+        float(np.min(metric_train)),
+    )
+
+
+def component_scaling_apply(inv: np.ndarray, weights: tuple) -> np.ndarray:
+    """``(inv − w1)·w2/w3 + w4`` with the reference's branches
+    (reference: baselines.py:105-109; the degenerate w3=0 case divides by
+    zero there — pinned to the train-split floor instead)."""
+    w1, w2, w3, w4 = weights
+    if inv.sum() > 0 and w3 > 0:
+        ts_hat = (inv - w1) * w2 / w3 + w4
+    elif inv.sum() > 0:
+        ts_hat = np.full_like(inv, w4)
+    else:
+        ts_hat = np.asarray(inv, dtype=np.float64)
+    return np.maximum(ts_hat, 1e-6)
+
+
 @dataclasses.dataclass
 class ComponentAwareBaseline:
     """Linear invocation-count → metric-range rescaling baseline."""
@@ -134,23 +161,8 @@ class ComponentAwareBaseline:
         ts = np.concatenate([y[:-1, 0, 0], y[-1, :, 0]])
 
         split_series = self.split + w - 1
-        inv_train = inv[:split_series]
-        metric_train = ts[:split_series]
-
-        w1 = np.min(inv_train)
-        w2 = np.max(metric_train) - np.min(metric_train)
-        w3 = np.max(inv_train) - np.min(inv_train)
-        w4 = np.min(metric_train)
-
-        if inv.sum() > 0 and w3 > 0:
-            ts_hat = (inv - w1) * w2 / w3 + w4
-        elif inv.sum() > 0:
-            # Degenerate invocation range: the reference divides by zero
-            # here; pin to the train-split floor instead.
-            ts_hat = np.full_like(inv, w4)
-        else:
-            ts_hat = inv
-        ts_hat = np.maximum(ts_hat, 1e-6)
+        weights = component_scaling_fit(inv[:split_series], ts[:split_series])
+        ts_hat = component_scaling_apply(inv, weights)
 
         windows = np.asarray([ts_hat[i - w:i] for i in range(w, len(ts) + 1)])
         return windows[self.split:][:, :, None]
