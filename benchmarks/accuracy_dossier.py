@@ -229,15 +229,16 @@ class MonthFitBaselines:
 
 def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
                 window, invocations, baselines, batch_size=64,
-                split_frac=0.4, anchor=False):
+                split=0, anchor=False):
     """MAE errors for DeepRest + both baselines on one corpus's windows.
 
     Every method is fit on the MONTH corpus only: DeepRest predicts with
     month-trained params and month normalization stats, the baselines
     transfer their month-fit state (``MonthFitBaselines``).  On the seen
-    corpus ``split_frac`` skips the train split (reference estimate.py
-    semantics); unseen corpora are evaluated end to end
-    (``split_frac=0``).  Test windows are NON-OVERLAPPING, strided by the
+    corpus pass ``split=bundle.split`` — the SAME window index every
+    method was fit through (recomputing it from a fraction here risks
+    fit-range leakage); unseen corpora are evaluated end to end
+    (``split=0``).  Test windows are NON-OVERLAPPING, strided by the
     window size — the reference's own eval protocol (estimate.py:85-88) —
     which also bounds the device feed: stride-1 would push every bucket
     through the model 60 times (~64 GB host→device at month scale, hours
@@ -254,13 +255,16 @@ def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
     level.  Returns {method: [N_eval, W, E] abs errors}.
     """
     from deeprest_tpu.data.windows import sliding_windows
+    from deeprest_tpu.train.data import eval_window_indices
 
     x_stats, y_stats = bundle_stats
     x_n = x_stats.apply(traffic).astype(np.float32)
     x_w = sliding_windows(x_n, window)                     # [N, W, F]
     n_windows = len(x_w)
-    split = int(n_windows * split_frac)
-    eval_index = np.arange(split, n_windows, window)
+    # The shared protocol helper (stride = window, uncapped): the dossier
+    # and trainer.evaluate must stay the same experiment.
+    eval_index = split + eval_window_indices(
+        n_windows - split, stride=window, max_cycles=n_windows)
 
     preds = trainer.predict(state, x_w[eval_index], batch_size=batch_size)
     med = trainer.model.median_index()
@@ -284,18 +288,21 @@ def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
 
 
 def summarize(report):
-    """Mean over metrics of each method's stats + win counts."""
+    """Mean over metrics of each method's stats + win counts + per-metric
+    winner (the single definition of "wins": lowest median MAE)."""
     methods = {}
     wins = {"deepr": 0, "resrc": 0, "comp": 0}
+    best_by_metric = {}
     for metric, by_method in report.items():
         best = min(by_method, key=lambda m: by_method[m]["median"])
+        best_by_metric[metric] = best
         wins[best] += 1
         for method, stats in by_method.items():
             acc = methods.setdefault(method, {k: [] for k in stats})
             for k, v in stats.items():
                 acc[k].append(v)
     return ({m: {k: float(np.mean(v)) for k, v in acc.items()}
-             for m, acc in methods.items()}, wins)
+             for m, acc in methods.items()}, wins, best_by_metric)
 
 
 def to_markdown(results, meta):
@@ -334,6 +341,20 @@ def to_markdown(results, meta):
         lines.append(f"DeepRest has the best median MAE on "
                      f"**{wins['deepr']} of {block['n_metrics']} metrics** "
                      f"(RESRC {wins['resrc']}, COMP {wins['comp']}).")
+        lines.append("")
+        # wins by resource class, the reference tables' grouping — the
+        # winner-per-metric comes from summarize(), the one owner of the
+        # win criterion
+        by_class: dict = {}
+        for metric, best in block["best_by_metric"].items():
+            resource = metric.rsplit("_", 1)[1]
+            cls = by_class.setdefault(resource, {"deepr": 0, "resrc": 0,
+                                                 "comp": 0, "n": 0})
+            cls[best] += 1
+            cls["n"] += 1
+        parts = [f"{res}: {c['deepr']}/{c['n']}"
+                 for res, c in sorted(by_class.items())]
+        lines.append(f"DeepRest wins by resource — {', '.join(parts)}.")
         lines.append("")
         lines.append("| method | median | p95 | p99 | max | (mean over metrics) |")
         lines.append("|---|---|---|---|---|---|")
@@ -475,8 +496,11 @@ def main():
     print(f"training {epochs} epochs on {bundle.split} windows...", flush=True)
     t0 = time.time()
     state, history = trainer.fit(bundle)
+    # --epochs 0 is the data-flow dry run: every stage downstream of
+    # training executes at full scale with the init state.
+    final_loss = history[-1].train_loss if history else float("nan")
     print(f"trained in {time.time()-t0:.0f}s; "
-          f"final train loss {history[-1].train_loss:.4f}", flush=True)
+          f"final train loss {final_loss:.4f}", flush=True)
 
     results = {}
 
@@ -494,14 +518,14 @@ def main():
     # ---- seen traffic: the month's held-out windows ----------------------
     errors = eval_corpus(trainer, state, (bundle.x_stats, bundle.y_stats),
                          traffic, targets, metric_names, window, invocations,
-                         baselines)
+                         baselines, split=bundle.split)
     from deeprest_tpu.train.metrics import mae_report
 
     report = mae_report(errors, metric_names)
-    summary, wins = summarize(report)
+    summary, wins, best = summarize(report)
     results["seen (month test split)"] = {
         "report": report, "summary": summary, "wins": wins,
-        "n_metrics": len(metric_names),
+        "best_by_metric": best, "n_metrics": len(metric_names),
     }
     print(f"seen: deepr wins {wins['deepr']}/{len(metric_names)}", flush=True)
 
@@ -525,10 +549,11 @@ def main():
         errors = eval_corpus(trainer, state,
                              (bundle.x_stats, bundle.y_stats),
                              u_traffic, u_targets, metric_names, window,
-                             u_inv, baselines, split_frac=0.0, anchor=True)
+                             u_inv, baselines, split=0, anchor=True)
         report = mae_report(errors, metric_names)
-        summary, wins = summarize(report)
+        summary, wins, best = summarize(report)
         results[name] = {"report": report, "summary": summary, "wins": wins,
+                         "best_by_metric": best,
                          "n_metrics": len(metric_names)}
         print(f"{name}: deepr wins {wins['deepr']}/{len(metric_names)} "
               f"({time.time()-t0:.0f}s)", flush=True)
