@@ -6,16 +6,18 @@
 #
 #   bash benchmarks/tpu_queue.sh [logdir]
 #
-# Steps:
+# Steps, ordered by artifact value per minute — the tunnel can wedge
+# again mid-queue, so the banked-artifact priority goes first:
 #   1. probe             — cheap device check, aborts the queue when down
-#   2. kernel_tuning     — fused-E80 E_BLK x T_BLK x dot-dtype sweep
+#   2. pallas_tpu_check  — 2-min numerics gate for the current kernels
+#   3. bench.py          — the headline (writes benchmarks/last_good_tpu.json)
+#   4. accuracy_dossier  — month-scale train + ACCURACY.md (the one
+#                          artifact no round has banked yet)
+#   5. kernel_tuning     — fused-E80 E_BLK x T_BLK x dot-dtype sweep
 #                          (read the result, then update E_BLK/T_BLK in
 #                          deeprest_tpu/ops/pallas_gru.py if a config wins)
-#   3. pallas_tpu_check  — kernel-vs-scan numerics + speedup proof
-#   4. bench.py          — the headline (writes benchmarks/last_good_tpu.json)
-#   5. sharded step      — pallas-under-GSPMD on the real chip (single chip:
+#   6. sharded step      — pallas-under-GSPMD on the real chip (single chip:
 #                          1x1x1 mesh exercises the jit+shard_map path)
-#   6. accuracy_dossier  — month-scale train + ACCURACY.md (longest)
 #   7. month_scale       — month-corpus throughput proof
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
@@ -35,9 +37,28 @@ step() {
 step probe 120 python -c "import jax; d = jax.devices()[0]; assert d.platform == 'tpu', d; print(d.device_kind)" \
   || { echo "TPU not reachable — queue aborted"; exit 1; }
 
-step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r4.json
 step pallas_check 900 python benchmarks/pallas_tpu_check.py --out benchmarks/pallas_tpu_result.json
 step bench 2400 python bench.py
+# Accuracy dossier immediately after the headline: the one artifact no
+# round has banked.  Gated on corpus freshness (below) and hoisted ahead
+# of tuning/sharded so a short window still produces ACCURACY.md.
+CORPUS=benchmarks/data/month_10k.jsonl
+if [ ! -f "$CORPUS" ] \
+   || [ deeprest_tpu/workload/telemetry.py -nt "$CORPUS" ] \
+   || [ deeprest_tpu/workload/simulator.py -nt "$CORPUS" ]; then
+  echo "SKIP accuracy/month_scale: $CORPUS missing or older than the"
+  echo "telemetry/simulator model — regenerate it first"
+  CORPUS_FRESH=0
+else
+  CORPUS_FRESH=1
+  # 12 epochs: an epoch at the 10k shape is ~30 s on-chip (17.7 steps/s
+  # measured), and the 2-epoch smoke runs were undertrained — the deep
+  # model needs the epochs to beat the baselines it is being judged
+  # against.
+  step accuracy 14400 python benchmarks/accuracy_dossier.py \
+    --features benchmarks/data/month_10k_features.npz --epochs 12
+fi
+step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r4.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.
@@ -60,23 +81,7 @@ st, loss = tr._train_step(st, x, y, w)
 print('pallas-under-GSPMD on-chip loss:', float(loss))
 assert np.isfinite(float(loss))
 " || true
-# Corpus-staleness gate: a corpus generated by an OLDER telemetry/
-# simulator model must not feed the dossier — the judged comparison
-# would silently run against retired physics.  Regenerate with the
-# snippet in benchmarks/month_scale.py's docstring when this trips.
-CORPUS=benchmarks/data/month_10k.jsonl
-if [ ! -f "$CORPUS" ] \
-   || [ deeprest_tpu/workload/telemetry.py -nt "$CORPUS" ] \
-   || [ deeprest_tpu/workload/simulator.py -nt "$CORPUS" ]; then
-  echo "SKIP accuracy/month_scale: $CORPUS missing or older than the"
-  echo "telemetry/simulator model — regenerate it first"
-else
-  # 12 epochs: an epoch at the 10k shape is ~30 s on-chip (17.7 steps/s
-  # measured), and the 2-epoch smoke runs were undertrained — the deep
-  # model needs the epochs to beat the baselines it is being judged
-  # against.
-  step accuracy 14400 python benchmarks/accuracy_dossier.py \
-    --features benchmarks/data/month_10k_features.npz --epochs 12
+if [ "$CORPUS_FRESH" = 1 ]; then
   step month_scale 7200 python benchmarks/month_scale.py \
     --features benchmarks/data/month_10k_features.npz --epochs 2
 fi
