@@ -399,6 +399,10 @@ def main():
                     help="force the CPU backend at FULL data scale — the "
                          "honest fallback dossier when the TPU tunnel is "
                          "down (meta.platform records it)")
+    ap.add_argument("--capacity", type=int, default=None,
+                    help="hash-feature capacity override (with --cpu: a "
+                         "reduced-width fallback dossier, e.g. 1024 — "
+                         "meta.feature_dim records what actually ran)")
     ap.add_argument("--limit-buckets", type=int, default=None,
                     help="use only the first N month buckets (with --cpu: "
                          "bounds the train cost; full-feature width kept)")
@@ -412,6 +416,16 @@ def main():
         SVC, EP, F_CAP, N_METRICS = 12, 8, 256, 8
     elif args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.capacity is not None:
+        if args.capacity <= 0:
+            ap.error(f"--capacity must be positive, got {args.capacity}")
+        F_CAP = args.capacity
+        # A non-default capacity must not poison the default cache: a
+        # later plain run would load it and label a reduced run "full".
+        if args.features == ap.get_default("features"):
+            args.features = os.path.join(
+                REPO, "benchmarks", "data",
+                f"month_c{F_CAP}_features.npz")
 
     from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
     from deeprest_tpu.data.featurize import CallPathSpace, FeaturizedData
@@ -442,6 +456,14 @@ def main():
         data0 = None
         if os.path.exists(args.features):
             data0 = FeaturizedData.load(args.features)
+            cached_cap = data0.space.config.capacity
+            if cached_cap != F_CAP:
+                # Refuse, don't silently re-ETL: overwriting the cache at
+                # a different width poisons later runs that load it and
+                # mislabel their scale.
+                sys.exit(f"features cache {args.features} has capacity "
+                         f"{cached_cap}, run wants {F_CAP} — pass a "
+                         f"capacity-specific --features path")
             if not data0.invocations:
                 # Cache predates invocation capture (month_scale.py wrote
                 # invocations={}); the component-aware baseline needs them.
@@ -560,9 +582,13 @@ def main():
 
     meta = {
         "mode": "SMOKE (numbers not representative)" if args.smoke
-                else "full dossier",
+                else ("REDUCED (capacity/limit overrides; see F and "
+                      "buckets_used)" if (args.capacity is not None
+                                          or args.limit_buckets)
+                      else "full dossier"),
         "platform": jax.devices()[0].platform,
         "corpus": os.path.basename(args.corpus),
+        "buckets_used": int(len(traffic)),
         "epochs": epochs,
         "feature_dim": feat_dim,
         "num_metrics": len(metric_names),

@@ -74,15 +74,38 @@ def stream_featurize(path: str, capacity: int, limit: int | None):
     return traffic, metrics, keys, space
 
 
-def select_metrics(metrics: np.ndarray, keys: list[str], k: int):
+def select_metrics(metrics: np.ndarray, keys: list[str], k: int,
+                   stratify: bool = True):
     """The k highest-signal series: largest coefficient of variation with a
     non-trivial mean (deterministic, documented selection — the reference
-    demo similarly scopes to 8 components x 5 resources)."""
+    demo similarly scopes to 8 components x 5 resources).
+
+    ``stratify=True`` splits the budget evenly across resource classes
+    (cpu/memory/write-iops/write-tp/usage) before ranking by CV: a global
+    CV ranking hands the whole budget to the spikiest class (observed:
+    40/40 write metrics), while the reference's tables span classes
+    (resource-estimation/README.md:84-100)."""
     mean = metrics.mean(axis=0)
     std = metrics.std(axis=0)
     cv = np.where(mean > 1e-3, std / np.maximum(mean, 1e-3), 0.0)
-    order = np.argsort(-cv)[:k]
-    order = np.sort(order)
+    if not stratify:
+        order = np.argsort(-cv)[:k]
+        return metrics[:, np.sort(order)], [keys[i] for i in np.sort(order)]
+    by_class: dict[str, list[int]] = {}
+    for i, key in enumerate(keys):
+        by_class.setdefault(key.rsplit("_", 1)[1], []).append(i)
+    # Round-robin across classes, best CV first within each: the split
+    # stays even by construction for ANY k (a quota-then-trim scheme can
+    # drop a whole low-variance class at the margin), and a class that
+    # runs out of members just cedes its turns to the rest.
+    ranked = {cls: sorted(by_class[cls], key=lambda i: -cv[i])
+              for cls in sorted(by_class)}
+    chosen: list[int] = []
+    while len(chosen) < k and any(ranked.values()):
+        for cls in sorted(ranked):
+            if ranked[cls] and len(chosen) < k:
+                chosen.append(ranked[cls].pop(0))
+    order = np.sort(np.asarray(chosen, dtype=np.int64))
     return metrics[:, order], [keys[i] for i in order]
 
 
