@@ -189,6 +189,34 @@ def main():
             results[f"unfused_equiv_fwdbwd{suffix}_ms"] = round(2 * v, 3)
     print(json.dumps(results, indent=2), flush=True)
 
+    # Round-5 knob sweep on the training path at the production dtype:
+    # STASH_GATES (backward recompute dot vs extra [E,T,B,3H] stream) ×
+    # LOOP_ORDER (expert-inner MXU pipelining vs time-inner weight reuse,
+    # applied to BOTH kernels).  Forward-only timings ride along because
+    # the knobs move different fractions of fwd vs bwd work.  The flags
+    # are read at trace time, so each config gets a fresh jit; restore is
+    # try/finally so an interrupt cannot leak a non-default config into
+    # later sweep phases.
+    default_stash, default_order = pallas_gru.STASH_GATES, pallas_gru.LOOP_ORDER
+    try:
+        for stash, order in itertools.product(
+                (True, False), ("expert_inner", "time_inner")):
+            pallas_gru.STASH_GATES = stash
+            pallas_gru.LOOP_ORDER = order
+            fn = jax.jit(jax.value_and_grad(
+                lambda p, w, b, h: jnp.sum(
+                    pallas_gru.gru_recurrence(p, w, b, h, False) ** 2),
+                argnums=(0, 1, 2, 3)))
+            record(f"fwdbwd_bf16_stash{int(stash)}_{order}_ms", fn,
+                   to_bf16(args80))
+            if stash:   # forward has no stash dimension; time only orders
+                fwd = jax.jit(functools.partial(pallas_gru.gru_recurrence,
+                                                interpret=False))
+                record(f"fwd_bf16_{order}_ms", fwd, to_bf16(args80))
+    finally:
+        pallas_gru.STASH_GATES = default_stash
+        pallas_gru.LOOP_ORDER = default_order
+
     # Blocking sweep at the fused stacking.  E candidates are the pallas-
     # tileable expert blocks (multiples of 8 dividing E2 — a 20-wide block
     # fails lowering: the expert axis is the sublane of the 2-D f32 bias

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Probe the tunneled TPU until it answers, then exit 0 — so an operator
+# (or the build driver) can chain `watch_tpu.sh && tpu_queue.sh`.  The
+# tunnel wedges for hours; every probe is timeout-bounded so a hung
+# backend init costs one interval, not the watch.
+#
+#   bash benchmarks/watch_tpu.sh [interval_s] [max_hours]
+LOG="${TPU_WATCH_LOG:-/tmp/tpu_watch.log}"
+INTERVAL="${1:-240}"
+MAX_HOURS="${2:-12}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+echo "watch start $(date -u +%FT%TZ) interval=${INTERVAL}s" >>"$LOG"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 180 python -c \
+      "import jax; d = jax.devices()[0]; assert d.platform == 'tpu', d; print('TPU up:', d.device_kind)" \
+      >>"$LOG" 2>&1; then
+    echo "TPU UP $(date -u +%FT%TZ)" >>"$LOG"
+    exit 0
+  fi
+  echo "down $(date -u +%FT%TZ)" >>"$LOG"
+  sleep "$INTERVAL"
+done
+echo "watch deadline reached $(date -u +%FT%TZ)" >>"$LOG"
+exit 1

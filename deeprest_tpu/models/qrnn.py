@@ -111,16 +111,24 @@ class QuantileGRU(nn.Module):
                 out = gru(cast(fwd), out, backend=cfg.rnn_backend)
             # layer 0 broadcasts [B,T,F] across experts; the output (and all
             # deeper layers) carry the expert axis: [E,B,T,D].
-        rnn_out = out.astype(jnp.float32)
+        # The post-RNN path stays in the model's compute dtype (bf16 for
+        # the flagship): rnn_out/mix are the largest activations outside
+        # the recurrence (~78 MB each at flagship scale in f32), and
+        # dropout + mixing + both head einsums each stream them through
+        # HBM.  All reductions still ACCUMULATE in f32 (the cross-expert
+        # sum explicitly, the head dots via preferred_element_type);
+        # only storage between ops is narrow.  f32 models are unchanged.
         rnn_out = nn.Dropout(rate=cfg.dropout_rate)(
-            rnn_out, deterministic=deterministic
+            out, deterministic=deterministic
         )
 
         # (c) cross-expert mixing + per-metric quantile heads
         # (reference: qrnn.py:46-55), via the O(E) sum-minus-own identity.
         if e > 1:
-            total = jnp.sum(rnn_out, axis=0, keepdims=True)           # [1,B,T,D]
-            mix = (total - rnn_out) / (e - 1)                         # [E,B,T,D]
+            total = jnp.sum(rnn_out.astype(jnp.float32), axis=0,
+                            keepdims=True)                            # [1,B,T,D]
+            mix = ((total - rnn_out.astype(jnp.float32)) / (e - 1)
+                   ).astype(compute_dtype)                            # [E,B,T,D]
         else:
             mix = rnn_out
 
@@ -135,8 +143,11 @@ class QuantileGRU(nn.Module):
         k_d = 1.0 / d_in ** 0.5
         head_w = self.param("head_w", uniform_pm(k_d), (e, d_in, q))
         head_b = self.param("head_b", uniform_pm(k_d), (e, q))
-        preds = (jnp.einsum("ebtd,edq->ebtq", mix, head_w[:, :d])
-                 + jnp.einsum("ebtd,edq->ebtq", rnn_out, head_w[:, d:]))
+        hw = head_w.astype(compute_dtype)
+        preds = (jnp.einsum("ebtd,edq->ebtq", mix, hw[:, :d],
+                            preferred_element_type=jnp.float32)
+                 + jnp.einsum("ebtd,edq->ebtq", rnn_out, hw[:, d:],
+                              preferred_element_type=jnp.float32))
         preds = preds + head_b[:, None, None, :]
         return jnp.transpose(preds, (1, 2, 0, 3))                     # [B,T,E,Q]
 

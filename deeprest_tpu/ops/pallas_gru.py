@@ -64,6 +64,34 @@ _SUBLANE = 8
 # buffered by the pallas pipeline and count twice.
 _VMEM_BUDGET = int(_os.environ.get("DEEPREST_GRU_VMEM_BUDGET",
                                    str(14 << 20)))
+# Stash the pre-activation hidden-side gates (h·W_hh + b_hh) from the
+# training forward so the backward skips its recompute dot — per
+# expert-step that removes one [B,H]x[H,3H] MXU dot (~1/3 of the
+# backward's dispatches) for one extra [E,T,B,3H] stream in the kernel's
+# I/O dtype each way (~0.3 ms HBM vs ~0.8 ms MXU at the flagship shape).
+# Env-tunable for the on-chip A/B (benchmarks/kernel_tuning.py).
+STASH_GATES = _os.environ.get("DEEPREST_GRU_STASH_GATES", "1") != "0"
+# In-program loop order.  "expert_inner" (default) walks time outer /
+# experts inner: each step issues E_BLK independent dots that pipeline
+# through the MXU.  "time_inner" walks expert outer / time inner: all of
+# one expert's steps run consecutively so the SAME W_hh tiles feed
+# consecutive dots — scheduler-friendlier for weight reuse, but the
+# sequential h dependency stalls between steps.  Which wins is a
+# hardware-scheduling question; benchmarks/kernel_tuning.py settles it.
+LOOP_ORDER = _os.environ.get("DEEPREST_GRU_LOOP_ORDER", "expert_inner")
+def _checked_loop_order() -> str:
+    """Validate LOOP_ORDER at every trace, not just env-var load — the
+    tuning sweep (and tests) assign the module global directly, and a typo
+    falling through an ``== "time_inner"`` check would silently mislabel
+    an on-chip A/B."""
+    if LOOP_ORDER not in ("expert_inner", "time_inner"):
+        raise ValueError(
+            f"DEEPREST_GRU_LOOP_ORDER={LOOP_ORDER!r}: must be "
+            f"'expert_inner' or 'time_inner'")
+    return LOOP_ORDER
+
+
+_checked_loop_order()   # fail fast on a bad env var at import too
 
 
 def _choose_blocks(e: int, t: int, per_expert_bytes) -> tuple[int, int]:
@@ -119,16 +147,19 @@ def _gates(xproj, gates_h):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, *refs, dot_dtype, emit_prev):
+def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, *refs, dot_dtype, emit_prev,
+                stash_gates, loop_order):
     # Training (emit_prev=True) also streams out the PRE-update hidden
     # state per step: the VJP consumes h_prev directly instead of
     # re-materializing it outside the kernel as concat(h0, h_all[:-1]) —
-    # one full [E,T,B,H] HBM round-trip saved per step.
-    if emit_prev:
-        out_ref, prev_ref, h_scr = refs
-    else:
-        out_ref, h_scr = refs
-        prev_ref = None
+    # one full [E,T,B,H] HBM round-trip saved per step.  With stash_gates
+    # the pre-activation hidden gates stream out too, so the backward
+    # skips its recompute dot entirely.
+    refs = list(refs)
+    out_ref = refs.pop(0)
+    prev_ref = refs.pop(0) if emit_prev else None
+    gates_ref = refs.pop(0) if (emit_prev and stash_gates) else None
+    (h_scr,) = refs
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -139,20 +170,31 @@ def _fwd_kernel(proj_ref, w_ref, b_ref, h0_ref, *refs, dot_dtype, emit_prev):
     hs = [h_scr[i] for i in range(n_e)]
     ws = [w_ref[i].astype(dot_dtype) for i in range(n_e)]
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
-    for tt in range(t_blk):           # time OUTER
-        for i in range(n_e):          # experts INNER: independent matmuls
-            if prev_ref is not None:
-                prev_ref[i, tt] = hs[i].astype(prev_ref.dtype)
-            gates_h = (
-                jax.lax.dot_general(hs[i].astype(dot_dtype), ws[i],
-                                    (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-                + bs[i]
-            )
-            xproj = proj_ref[i, tt].astype(jnp.float32)
-            r, z, n, _ = _gates(xproj, gates_h)
-            hs[i] = (1.0 - z) * n + z * hs[i]
-            out_ref[i, tt] = hs[i].astype(out_ref.dtype)
+
+    def step(i, tt):
+        if prev_ref is not None:
+            prev_ref[i, tt] = hs[i].astype(prev_ref.dtype)
+        gates_h = (
+            jax.lax.dot_general(hs[i].astype(dot_dtype), ws[i],
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            + bs[i]
+        )
+        if gates_ref is not None:
+            gates_ref[i, tt] = gates_h.astype(gates_ref.dtype)
+        xproj = proj_ref[i, tt].astype(jnp.float32)
+        r, z, n, _ = _gates(xproj, gates_h)
+        hs[i] = (1.0 - z) * n + z * hs[i]
+        out_ref[i, tt] = hs[i].astype(out_ref.dtype)
+
+    if loop_order == "time_inner":
+        for i in range(n_e):          # experts OUTER: W_hh stays hot
+            for tt in range(t_blk):   # time INNER: sequential chain
+                step(i, tt)
+    else:
+        for tt in range(t_blk):       # time OUTER
+            for i in range(n_e):      # experts INNER: independent matmuls
+                step(i, tt)
     for i in range(n_e):
         h_scr[i] = hs[i]
 
@@ -188,21 +230,35 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
     io = proj.dtype.itemsize
     out_dtype = _out_dtype_for(proj.dtype)
     oo = jnp.dtype(out_dtype).itemsize
-    n_out = 2 if emit_prev else 1
+    stash = emit_prev and STASH_GATES
+    n_h_out = 2 if emit_prev else 1
     per_expert = lambda t_blk: (
-        # proj in + out (and prev out when training), double-buffered
-        2 * (t_blk * b * g3 * io + n_out * t_blk * b * h * oo)
+        # proj in + h out (+ prev out and gates out when training),
+        # double-buffered
+        2 * (t_blk * b * g3 * io + n_h_out * t_blk * b * h * oo
+             + (t_blk * b * g3 * io if stash else 0))
         + h * g3 * w_hh.dtype.itemsize + g3 * 4          # W_hh, b_hh resident
         + b * h * h0.dtype.itemsize + b * h * 4          # h0 block + scratch
     )
     e_blk, t_blk = _choose_blocks(e, t, per_expert)
     eb = e // e_blk
     grid = (eb, t // t_blk)
-    out_spec = pl.BlockSpec((e_blk, t_blk, b, h), lambda i, j: (i, j, 0, 0))
-    out_shape = jax.ShapeDtypeStruct((e, t, b, h), out_dtype)
+    h_spec = pl.BlockSpec((e_blk, t_blk, b, h), lambda i, j: (i, j, 0, 0))
+    h_shape = jax.ShapeDtypeStruct((e, t, b, h), out_dtype)
+    out_specs, out_shape = [h_spec], [h_shape]
+    if emit_prev:
+        out_specs.append(h_spec)
+        out_shape.append(h_shape)
+    if stash:
+        out_specs.append(
+            pl.BlockSpec((e_blk, t_blk, b, g3), lambda i, j: (i, j, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((e, t, b, g3), proj.dtype))
+    if not emit_prev:
+        out_specs, out_shape = out_specs[0], out_shape[0]
     return pl.pallas_call(
         functools.partial(_fwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype),
-                          emit_prev=emit_prev),
+                          emit_prev=emit_prev, stash_gates=stash,
+                          loop_order=_checked_loop_order()),
         grid=grid,
         in_specs=[
             pl.BlockSpec((e_blk, t_blk, b, g3), lambda i, j: (i, j, 0, 0)),
@@ -210,8 +266,8 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
             pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
             pl.BlockSpec((e_blk, b, h), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=[out_spec] * n_out if emit_prev else out_spec,
-        out_shape=[out_shape] * n_out if emit_prev else out_shape,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
@@ -225,9 +281,17 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
-                dproj_ref, dw_ref, db_ref, dh0_ref,
-                dh_scr, dw_scr, db_scr, dg_scr, *, dot_dtype):
+def _bwd_kernel(proj_ref, hprev_ref, *refs, dot_dtype, stash_gates,
+                loop_order):
+    if stash_gates:
+        (gates_in_ref, w_ref, b_ref, dout_ref,
+         dproj_ref, dw_ref, db_ref, dh0_ref,
+         dh_scr, dw_scr, db_scr, dg_scr) = refs
+    else:
+        gates_in_ref = None
+        (w_ref, b_ref, dout_ref,
+         dproj_ref, dw_ref, db_ref, dh0_ref,
+         dh_scr, dw_scr, db_scr, dg_scr) = refs
     t = pl.program_id(1)
     t_total = pl.num_programs(1)
 
@@ -242,40 +306,53 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
     bs = [b_ref[i].astype(jnp.float32) for i in range(n_e)]
     dhs = [dh_scr[i] for i in range(n_e)]
     dbs = [db_scr[i] for i in range(n_e)]
-    for tt in reversed(range(t_blk)):  # time OUTER, back-to-front in-block
-        for i in range(n_e):           # experts INNER: independent matmuls
-            h_prev = hprev_ref[i, tt].astype(jnp.float32)
+    def step(i, tt):
+        h_prev = hprev_ref[i, tt].astype(jnp.float32)
+        if gates_in_ref is not None:
+            # Forward stashed the pre-activation hidden gates — no
+            # recompute dot (1/3 of this kernel's per-step MXU work).
+            gates_h = gates_in_ref[i, tt].astype(jnp.float32)
+        else:
             gates_h = (
                 jax.lax.dot_general(h_prev.astype(dot_dtype), ws[i],
                                     (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
                 + bs[i]
             )
-            xproj = proj_ref[i, tt].astype(jnp.float32)
-            r, z, n, hn = _gates(xproj, gates_h)
+        xproj = proj_ref[i, tt].astype(jnp.float32)
+        r, z, n, hn = _gates(xproj, gates_h)
 
-            dh_total = dout_ref[i, tt].astype(jnp.float32) + dhs[i]
-            dn = dh_total * (1.0 - z)
-            dz = dh_total * (h_prev - n)
-            dtanh = dn * (1.0 - n * n)
-            da_r = dtanh * hn * r * (1.0 - r)
-            da_z = dz * z * (1.0 - z)
-            dhn = dtanh * r
-            dgates_h = jnp.concatenate([da_r, da_z, dhn], axis=-1)   # [B,3H]
-            dproj_ref[i, tt] = jnp.concatenate(
-                [da_r, da_z, dtanh], axis=-1
-            ).astype(dproj_ref.dtype)
+        dh_total = dout_ref[i, tt].astype(jnp.float32) + dhs[i]
+        dn = dh_total * (1.0 - z)
+        dz = dh_total * (h_prev - n)
+        dtanh = dn * (1.0 - n * n)
+        da_r = dtanh * hn * r * (1.0 - r)
+        da_z = dz * z * (1.0 - z)
+        dhn = dtanh * r
+        dgates_h = jnp.concatenate([da_r, da_z, dhn], axis=-1)   # [B,3H]
+        dproj_ref[i, tt] = jnp.concatenate(
+            [da_r, da_z, dtanh], axis=-1
+        ).astype(dproj_ref.dtype)
 
-            # dh_prev = dh·z + dgates_h @ W_hhᵀ   (contract the 3H axis)
-            dhs[i] = dh_total * z + jax.lax.dot_general(
-                dgates_h.astype(dot_dtype), ws[i], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            # Stash dgates for the block-batched dW dot below.  In the
-            # bf16 path this is the SAME quantization the old per-step dW
-            # dot applied (dgates were cast to the dot dtype anyway).
-            dg_scr[i, tt] = dgates_h.astype(dg_scr.dtype)
-            dbs[i] = dbs[i] + jnp.sum(dgates_h, axis=0)
+        # dh_prev = dh·z + dgates_h @ W_hhᵀ   (contract the 3H axis)
+        dhs[i] = dh_total * z + jax.lax.dot_general(
+            dgates_h.astype(dot_dtype), ws[i], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # Stash dgates for the block-batched dW dot below.  In the
+        # bf16 path this is the SAME quantization the old per-step dW
+        # dot applied (dgates were cast to the dot dtype anyway).
+        dg_scr[i, tt] = dgates_h.astype(dg_scr.dtype)
+        dbs[i] = dbs[i] + jnp.sum(dgates_h, axis=0)
+
+    if loop_order == "time_inner":
+        for i in range(n_e):               # experts OUTER: W_hh stays hot
+            for tt in reversed(range(t_blk)):
+                step(i, tt)
+    else:
+        for tt in reversed(range(t_blk)):  # time OUTER, back-to-front
+            for i in range(n_e):           # experts INNER
+                step(i, tt)
     for i in range(n_e):
         # dW_hh += h_prevᵀ @ dgates, contracted over the WHOLE time block
         # (K = t_blk·B instead of B): one MXU dot per block instead of one
@@ -298,7 +375,7 @@ def _bwd_kernel(proj_ref, hprev_ref, w_ref, b_ref, dout_ref,
         dh0_ref[...] = dh_scr[...]
 
 
-def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
+def _bwd_call(proj, h_prev_all, gates_all, w_hh, b_hh, dout, interpret):
     e, t, b, g3 = proj.shape
     h = g3 // 3
     assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
@@ -306,11 +383,14 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     dot_io = jnp.dtype(_dot_dtype_for(proj.dtype)).itemsize
     hp_io = h_prev_all.dtype.itemsize
     do_io = dout.dtype.itemsize
+    stash = gates_all is not None
     per_expert = lambda t_blk: (
-        # time-grid blocks, double-buffered: proj, h_prev, dout in;
-        # dproj out (h_prev/dout ride the model's out dtype — _vjp_bwd)
+        # time-grid blocks, double-buffered: proj, h_prev, dout (and the
+        # stashed gates when present) in; dproj out (h_prev/dout ride the
+        # model's out dtype — _vjp_bwd)
         2 * (t_blk * b * g3 * io + t_blk * b * h * (hp_io + do_io)
-             + t_blk * b * g3 * io)
+             + t_blk * b * g3 * io
+             + (t_blk * b * g3 * io if stash else 0))
         # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch,
         # dgates stash (dot dtype) for the block-batched dW dot
         + h * g3 * w_hh.dtype.itemsize + g3 * 4
@@ -323,16 +403,25 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
     nb = t // t_blk
     grid = (eb, nb)
     rev = lambda i, j: (i, nb - 1 - j, 0, 0)  # walk time blocks back-to-front
+    in_specs = [
+        pl.BlockSpec((e_blk, t_blk, b, g3), rev),
+        pl.BlockSpec((e_blk, t_blk, b, h), rev),
+    ]
+    operands = [proj, h_prev_all]
+    if stash:
+        in_specs.append(pl.BlockSpec((e_blk, t_blk, b, g3), rev))
+        operands.append(gates_all)
+    in_specs += [
+        pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
+        pl.BlockSpec((e_blk, t_blk, b, h), rev),
+    ]
+    operands += [w_hh, b_hh, dout]
     dproj, dw, db, dh0 = pl.pallas_call(
-        functools.partial(_bwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype)),
+        functools.partial(_bwd_kernel, dot_dtype=_dot_dtype_for(proj.dtype),
+                          stash_gates=stash, loop_order=_checked_loop_order()),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((e_blk, t_blk, b, g3), rev),
-            pl.BlockSpec((e_blk, t_blk, b, h), rev),
-            pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((e_blk, g3), lambda i, j: (i, 0)),
-            pl.BlockSpec((e_blk, t_blk, b, h), rev),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((e_blk, t_blk, b, g3), rev),
             pl.BlockSpec((e_blk, h, g3), lambda i, j: (i, 0, 0)),
@@ -355,7 +444,7 @@ def _bwd_call(proj, h_prev_all, w_hh, b_hh, dout, interpret):
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(proj, h_prev_all, w_hh, b_hh, dout)
+    )(*operands)
     return dproj, dw, db, dh0
 
 
@@ -392,16 +481,21 @@ def _vjp_fwd(proj, w_hh, b_hh, h0, interpret):
     # backward consumes it without the concat(h0, h_all[:-1]) round-trip,
     # and h_all itself is NOT a residual (the recompute needs only
     # h_prev).  h0 rides along for its dtype/shape (tiny next to the
-    # [E,T,B,H] stash this replaces).
-    h_all, h_prev_all = _fwd_call(proj, w_hh, b_hh, h0, interpret,
-                                  emit_prev=True)
-    return h_all, (proj, w_hh, b_hh, h0, h_prev_all)
+    # [E,T,B,H] stash this replaces).  With STASH_GATES the pre-activation
+    # hidden gates ride as a third output so the backward skips its
+    # recompute dot.
+    outs = _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=True)
+    if STASH_GATES:
+        h_all, h_prev_all, gates_all = outs
+    else:
+        (h_all, h_prev_all), gates_all = outs, None
+    return h_all, (proj, w_hh, b_hh, h0, h_prev_all, gates_all)
 
 
 def _vjp_bwd(interpret, res, dout):
-    proj, w_hh, b_hh, h0, h_prev_all = res
+    proj, w_hh, b_hh, h0, h_prev_all, gates_all = res
     dproj, dw, db, dh0 = _bwd_call(
-        proj, h_prev_all, w_hh, b_hh,
+        proj, h_prev_all, gates_all, w_hh, b_hh,
         dout.astype(_out_dtype_for(proj.dtype)), interpret
     )
     return (dproj, dw.astype(w_hh.dtype), db.astype(b_hh.dtype),

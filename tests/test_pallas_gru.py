@@ -148,6 +148,43 @@ def test_bf16_proj_io_matches_bf16_scan():
         assert np.max(np.abs(a - b_)) < 0.15 * (1e-3 + np.max(np.abs(a)))
 
 
+@pytest.mark.parametrize("stash", [True, False])
+@pytest.mark.parametrize("order", ["expert_inner", "time_inner"])
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+@pytest.mark.slow
+def test_kernel_knob_configs_match_scan(monkeypatch, stash, order, dtype):
+    """Every STASH_GATES × LOOP_ORDER config must agree with the scan
+    backend in values and grads, in BOTH dtypes (the bf16 non-stash path
+    is the recompute-dot branch; f32 stash is a lossless round-trip) —
+    whichever config loses the on-chip tuning A/B
+    (benchmarks/kernel_tuning.py) must not rot into broken code, because
+    the knobs exist precisely so the default can flip."""
+    from deeprest_tpu.ops import pallas_gru
+
+    monkeypatch.setattr(pallas_gru, "STASH_GATES", stash)
+    monkeypatch.setattr(pallas_gru, "LOOP_ORDER", order)
+    params, x, _ = _setup(t=9)
+    if dtype == "bf16":
+        params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+        x = x.astype(jnp.bfloat16)
+
+    def loss(backend, x):
+        out = bidirectional_gru(params, params, x, backend=backend)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    tol = dict(rtol=1e-5) if dtype == "f32" else dict(rtol=2e-2)
+    np.testing.assert_allclose(
+        float(loss("pallas_interpret", x)), float(loss("scan", x)), **tol)
+    g_ref = np.asarray(jax.grad(lambda x: loss("scan", x))(x), np.float32)
+    g_pl = np.asarray(jax.grad(lambda x: loss("pallas_interpret", x))(x),
+                      np.float32)
+    if dtype == "f32":
+        np.testing.assert_allclose(g_pl, g_ref, rtol=2e-4, atol=2e-4)
+    else:
+        assert np.max(np.abs(g_pl - g_ref)) < 0.15 * (
+            1e-3 + np.max(np.abs(g_ref)))
+
+
 @pytest.mark.slow
 def test_gradient_wrt_input_matches_scan():
     params, x, _ = _setup()
