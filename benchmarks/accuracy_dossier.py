@@ -229,7 +229,7 @@ class MonthFitBaselines:
 
 def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
                 window, invocations, baselines, batch_size=64,
-                split=0, anchor=False):
+                split=0, delta_mask=None):
     """MAE errors for DeepRest + both baselines on one corpus's windows.
 
     Every method is fit on the MONTH corpus only: DeepRest predicts with
@@ -244,15 +244,20 @@ def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
     through the model 60 times (~64 GB host→device at month scale, hours
     over the tunneled chip).
 
-    ``anchor=True`` (unseen corpora): memory/usage are LEVEL-tracking
-    accumulators whose absolute value encodes a history the evaluated
-    corpus does not share — the reference's own demo re-anchors exactly
-    these series to the last observed value before comparing
+    Level-tracking accumulators (memory/usage, ``ANCHORED_RESOURCES``)
+    are re-anchored in EVERY scenario: their absolute value encodes a
+    history neither the traffic (seen or unseen) nor a transferred
+    baseline can know — the reference's own demo re-anchors exactly these
+    series to the last observed value before comparing
     (web-demo/dataloader.py:143-156, mirrored in demo/results.py).  Every
     method's window predictions are shifted so their first element matches
     the window's first observation; all three methods get the identical
     anchoring, so the comparison measures predicted SHAPE, not inherited
-    level.  Returns {method: [N_eval, W, E] abs errors}.
+    level.  ``delta_mask`` (``bundle.delta_mask``) marks metrics DeepRest
+    predicts as per-bucket increments (train/data.py delta formulation):
+    those columns are integrated (cumulative sum) before the shared
+    anchoring fixes their offset.  Returns {method: [N_eval, W, E] abs
+    errors}.
     """
     from deeprest_tpu.data.windows import sliding_windows
     from deeprest_tpu.train.data import eval_window_indices
@@ -273,17 +278,28 @@ def eval_corpus(trainer, state, bundle_stats, traffic, targets, metric_names,
     lo = np.asarray(y_stats.min).reshape(1, 1, -1)
     hi = np.asarray(y_stats.max).reshape(1, 1, -1)
     preds_denorm = preds_n * (hi - lo) + lo
+    anchored = [j for j, n in enumerate(metric_names)
+                if n.rsplit("_", 1)[1] in ANCHORED_RESOURCES]
+    if delta_mask is not None and delta_mask.any():
+        # Delta-trained columns are increments: integrate to level shape
+        # (shared helper — the one owner of the delta→level contract).
+        # The offset is arbitrary here — the shared anchoring below fixes
+        # it, which requires every delta column to be an anchored one.
+        if not set(np.flatnonzero(delta_mask)) <= set(anchored):
+            raise ValueError(
+                "delta-trained metrics must be anchored resources "
+                f"(ANCHORED_RESOURCES={ANCHORED_RESOURCES})")
+        from deeprest_tpu.train.data import integrate_level_columns
+
+        preds_denorm = integrate_level_columns(preds_denorm, delta_mask)
 
     labels = sliding_windows(targets, window)[eval_index]   # raw scale
 
     predictions = baselines.predict(invocations, len(targets), eval_index)
     predictions["deepr"] = preds_denorm
-    if anchor:
-        anchored = [j for j, n in enumerate(metric_names)
-                    if n.rsplit("_", 1)[1] in ANCHORED_RESOURCES]
-        for arr in predictions.values():
-            arr[:, :, anchored] += (labels[:, :1, anchored]
-                                    - arr[:, :1, anchored])
+    for arr in predictions.values():
+        arr[:, :, anchored] += (labels[:, :1, anchored]
+                                - arr[:, :1, anchored])
     return {m: np.abs(p - labels) for m, p in predictions.items()}
 
 
@@ -327,11 +343,15 @@ def to_markdown(results, meta):
         "supply invocation counts and ground truth, never fitting data "
         "(fitting a baseline on the unseen corpus's own history would "
         "hand it the very information whose absence defines the task).  "
-        "On unseen corpora the level-tracking accumulators (memory, "
-        "usage) are re-anchored to each window's first observation for "
-        "ALL methods — the reference demo's own semantics for exactly "
-        "these series (web-demo/dataloader.py:143-156): their absolute "
-        "level encodes a history the fresh corpus does not share.",
+        "Level-tracking accumulators (memory, usage) are re-anchored to "
+        "each window's first observation for ALL methods in EVERY "
+        "scenario — the reference demo's own semantics for exactly these "
+        "series (web-demo/dataloader.py:143-156): their absolute level "
+        "encodes a history the traffic cannot see, so the comparison "
+        "measures predicted shape from a shared anchor.  DeepRest "
+        "additionally models delta-formulated resources (disk usage) as "
+        "per-bucket increments integrated from the window anchor "
+        "(train/data.py), the modeling counterpart of that re-anchoring.",
         "",
     ]
     for scenario, block in results.items():
@@ -406,7 +426,20 @@ def main():
     ap.add_argument("--limit-buckets", type=int, default=None,
                     help="use only the first N month buckets (with --cpu: "
                          "bounds the train cost; full-feature width kept)")
+    ap.add_argument("--delta-resources", default=None,
+                    help="comma-separated resources trained as per-bucket "
+                         "increments (default: TrainConfig default; 'none' "
+                         "disables — the A/B lever for the delta head)")
     args = ap.parse_args()
+    if args.delta_resources is not None:
+        requested = {r for r in args.delta_resources.split(",")
+                     if r and r != "none"}
+        bad = requested - set(ANCHORED_RESOURCES)
+        if bad:
+            # Fail BEFORE the hours-long train: eval integrates delta
+            # columns and the shared anchoring only covers these resources.
+            ap.error(f"--delta-resources {sorted(bad)} are not anchored "
+                     f"resources {ANCHORED_RESOURCES}")
 
     import jax
 
@@ -511,7 +544,11 @@ def main():
                           if not (args.smoke or args.cpu) else "float32"),
         train=TrainConfig(batch_size=32, window_size=window,
                           num_epochs=epochs, log_every_steps=0, seed=0,
-                          eval_stride=window),
+                          eval_stride=window,
+                          **({} if args.delta_resources is None else {
+                              "delta_resources": tuple(
+                                  r for r in args.delta_resources.split(",")
+                                  if r and r != "none")})),
     )
     bundle = prepare_dataset(data, cfg.train)
     trainer = Trainer(cfg, feat_dim, metric_names)
@@ -540,7 +577,8 @@ def main():
     # ---- seen traffic: the month's held-out windows ----------------------
     errors = eval_corpus(trainer, state, (bundle.x_stats, bundle.y_stats),
                          traffic, targets, metric_names, window, invocations,
-                         baselines, split=bundle.split)
+                         baselines, split=bundle.split,
+                         delta_mask=bundle.delta_mask)
     from deeprest_tpu.train.metrics import mae_report
 
     report = mae_report(errors, metric_names)
@@ -571,7 +609,8 @@ def main():
         errors = eval_corpus(trainer, state,
                              (bundle.x_stats, bundle.y_stats),
                              u_traffic, u_targets, metric_names, window,
-                             u_inv, baselines, split=0, anchor=True)
+                             u_inv, baselines, split=0,
+                             delta_mask=bundle.delta_mask)
         report = mae_report(errors, metric_names)
         summary, wins, best = summarize(report)
         results[name] = {"report": report, "summary": summary, "wins": wins,

@@ -262,10 +262,13 @@ def cmd_train(args) -> int:
                                   cfg.train.eval_max_cycles)
         preds = trainer.predict(state, bundle.x_test[idx])   # [N, W, E, Q]
         med = trainer.model.median_index()
-        denorm = lambda q: bundle.denorm_targets(
-            np.maximum(preds[..., q], 1e-6))
+        # Delta-trained columns plot in LEVEL space via the bundle's shared
+        # reconstruction (the same contract trainer.evaluate reports).
+        labels = bundle.level_labels(idx)
+        denorm = lambda q: bundle.integrate_test_preds(
+            bundle.denorm_targets(np.maximum(preds[..., q], 1e-6)), idx)
         prediction_plots(
-            denorm(med), bundle.denorm_targets(bundle.y_test[idx]),
+            denorm(med), labels,
             bundle.metric_names, args.plots_dir,
             quantile_band=(denorm(0), denorm(preds.shape[-1] - 1)),
         )

@@ -45,6 +45,15 @@ class ModelConfig:
         return self.hidden_size * self.directions
 
 
+LEVEL_RESOURCES = ("usage",)
+"""Resources modeled as per-bucket increments by default (the
+``TrainConfig.delta_resources`` default).  Disk usage accumulates writes —
+a level whose absolute value encodes history the traffic cannot see;
+predicting its CHANGE and integrating from a window anchor is the modeling
+counterpart of the re-anchoring the reference demo applies to exactly
+these level-type series (reference: web-demo/dataloader.py:143-156)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training-loop knobs (reference: resource-estimation/estimate.py:13-18)."""
@@ -69,6 +78,16 @@ class TrainConfig:
     # reference ships every batch synchronously, estimate.py:68-69).
     # 0 disables prefetch.
     prefetch_depth: int = 2
+    # Resources trained as per-bucket INCREMENTS instead of absolute
+    # levels.  Disk usage is an integrator — its absolute value encodes a
+    # history API traffic cannot see, so a traffic→level regression
+    # structurally trails a persistence baseline; its per-bucket CHANGE is
+    # what traffic causes (the reference demo re-anchors exactly these
+    # level-type series before comparing, web-demo/dataloader.py:143-156).
+    # Predictions for these resources are integrated from the window
+    # anchor at eval/serve time (train/data.py:integrate_level_columns).
+    # Empty tuple disables the delta formulation entirely.
+    delta_resources: tuple[str, ...] = LEVEL_RESOURCES
 
 
 @dataclasses.dataclass(frozen=True)

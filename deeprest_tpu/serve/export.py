@@ -66,6 +66,8 @@ def export_predictor(pred: Predictor, directory: str) -> str:
         "y_stats": pred.y_stats.to_dict(),
         "model_config": dataclasses.asdict(pred.model_config),
         "space": pred.space_dict,
+        "delta_mask": (np.asarray(pred.delta_mask, bool).tolist()
+                       if pred.delta_mask is not None else None),
     }
     with open(os.path.join(directory, ARTIFACT_MANIFEST), "w",
               encoding="utf-8") as f:
@@ -94,6 +96,8 @@ class ExportedPredictor:
         self.x_stats = MinMaxStats.from_dict(manifest["x_stats"])
         self.y_stats = MinMaxStats.from_dict(manifest["y_stats"])
         self.space_dict = manifest.get("space")
+        dm = manifest.get("delta_mask")
+        self.delta_mask = np.asarray(dm, bool) if dm is not None else None
 
     @classmethod
     def load(cls, directory: str) -> "ExportedPredictor":
@@ -121,4 +125,5 @@ class ExportedPredictor:
         tiling semantics as the in-process Predictor."""
         return rolled_prediction(
             self._exported.call, self.x_stats, self.y_stats,
-            self.window_size, traffic)
+            self.window_size, traffic,
+            delta_mask=self.delta_mask, median_index=self.median_index())

@@ -20,7 +20,9 @@ from deeprest_tpu.models.qrnn import QuantileGRU
 
 def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
                       window_size: int, traffic: np.ndarray,
-                      max_batch: int = 64) -> np.ndarray:
+                      max_batch: int = 64,
+                      delta_mask: np.ndarray | None = None,
+                      median_index: int | None = None) -> np.ndarray:
     """[T, F] raw traffic → de-normalized [T, E, Q] predictions.
 
     The series is tiled into non-overlapping windows (last window
@@ -32,11 +34,24 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
     batch of them is ever resident on device).  Shared by the in-process
     Predictor and the exported-artifact loader so both serve identical
     semantics by construction.
+
+    ``delta_mask`` marks metrics the model predicts as per-bucket
+    increments (train/data.py delta formulation): those columns are
+    integrated back to a LEVEL series — each window's cumulative sum,
+    chained across windows on the median quantile so the rollout is
+    continuous.  The absolute offset is a pure-prediction rollout from 0
+    (no observations exist here); consumers with observations re-anchor
+    (AnomalyDetector, the demo's results layer).  Quantile columns are
+    offset from the shared median base, so the band reflects within-
+    window uncertainty rather than compounding across the whole series.
     """
     w = window_size
     t = len(traffic)
     if t < w:
         raise ValueError(f"series length {t} < window_size {w}")
+    if delta_mask is not None and delta_mask.any() and median_index is None:
+        raise ValueError("delta_mask requires median_index for the "
+                         "cross-window carry")
     starts = list(range(0, t - w + 1, w))
     if starts[-1] != t - w:
         starts.append(t - w)
@@ -53,6 +68,14 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
         if out is None:
             out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
         for s, window in zip(chunk, preds):
+            if delta_mask is not None and delta_mask.any():
+                window = np.array(window, copy=True)
+                c = np.cumsum(window[:, delta_mask, :], axis=0)
+                # carry: the already-written median level one step before
+                # this window (0 for the very first step of the series)
+                base = (out[s - 1, delta_mask, median_index][None, :, None]
+                        if s > 0 else 0.0)
+                window[:, delta_mask, :] = base + c
             out[s:s + w] = window      # later (right-aligned) window wins
     return out
 
@@ -63,7 +86,8 @@ class Predictor:
     def __init__(self, params, model_config: ModelConfig,
                  x_stats: MinMaxStats, y_stats: MinMaxStats,
                  metric_names: list[str], window_size: int,
-                 space_dict: dict | None = None):
+                 space_dict: dict | None = None,
+                 delta_mask: np.ndarray | None = None):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -73,6 +97,11 @@ class Predictor:
         # serialized CallPathSpace of the training corpus (if checkpointed):
         # lets consumers featurize raw traces column-exactly — see space()
         self.space_dict = space_dict
+        # [E] bool: metrics the model predicts as per-bucket increments
+        # (train/data.py delta formulation); predict_series integrates
+        # them back to levels.  None (pre-delta checkpoints): no-op.
+        self.delta_mask = (np.asarray(delta_mask, bool)
+                           if delta_mask is not None else None)
         self._apply = jax.jit(
             lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
         )
@@ -146,6 +175,7 @@ class Predictor:
             metric_names=metric_names,
             window_size=extra["window_size"],
             space_dict=extra.get("space"),
+            delta_mask=extra.get("delta_mask"),
         )
 
     def space(self):
@@ -161,7 +191,9 @@ class Predictor:
 
     def predict_series(self, traffic: np.ndarray) -> np.ndarray:
         """[T, F] raw traffic features → de-normalized [T, E, Q] predictions
-        (see :func:`rolled_prediction` for the tiling semantics)."""
+        (see :func:`rolled_prediction` for the tiling semantics; delta-
+        trained metrics come back integrated to a relative level series)."""
         return rolled_prediction(
             lambda x: self._apply(self.params, jnp.asarray(x)),
-            self.x_stats, self.y_stats, self.window_size, traffic)
+            self.x_stats, self.y_stats, self.window_size, traffic,
+            delta_mask=self.delta_mask, median_index=self.median_index())

@@ -202,10 +202,18 @@ class PredictionService:
     def predict(self, payload: dict) -> dict:
         traffic = self._traffic_array(payload)
         preds = self.predictor.predict_series(traffic)        # [T, E, Q]
+        dm = getattr(self.predictor, "delta_mask", None)
         return {
             "metric_names": self.predictor.metric_names,
             "quantiles": list(self.predictor.quantiles),
             "predictions": preds.tolist(),
+            # Delta-trained metrics are a RELATIVE (rollout-from-zero)
+            # level series — clients must re-anchor them to an observed
+            # level before treating values as absolute utilization.
+            "relative_metrics": [
+                m for e, m in enumerate(self.predictor.metric_names)
+                if dm is not None and bool(dm[e])
+            ],
         }
 
     def _require_whatif(self) -> WhatIfEstimator:

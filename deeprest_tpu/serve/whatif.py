@@ -31,6 +31,10 @@ class WhatIfEstimator:
     def endpoints(self) -> list[str]:
         return self.synthesizer.endpoints
 
+    def _is_relative(self, e: int) -> bool:
+        dm = self.predictor.delta_mask
+        return dm is not None and bool(dm[e])
+
     def estimate(
         self,
         expected_traffic: list[dict[str, int]],
@@ -39,7 +43,12 @@ class WhatIfEstimator:
         """``expected_traffic[t] = {endpoint: count}`` → per-metric series.
 
         Returns ``{metric: {"q05"|"q50"|"q95": [T] utilization}}`` (keys
-        follow the configured quantiles).
+        follow the configured quantiles).  Delta-trained metrics
+        (``predictor.delta_mask``, e.g. disk usage) come back as RELATIVE
+        growth from the start of the hypothetical program — there is no
+        observed level to anchor a what-if to; the reference demo
+        re-anchors exactly these series before display
+        (web-demo/dataloader.py:143-156).
         """
         x = self.synthesizer.synthesize_series(expected_traffic, seed=seed)
         preds = self.predictor.predict_series(x)          # [T, E, Q]
@@ -60,12 +69,20 @@ class WhatIfEstimator:
     ) -> dict[str, float]:
         """Per-metric peak scaling factor between two traffic programs
         (the number the reference demo renders as bar charts,
-        web-demo/dataloader.py:143-156)."""
+        web-demo/dataloader.py:143-156).  For delta-trained level metrics
+        the factor compares GROWTH over the program (peak minus start) —
+        the reference demo's own post-re-anchor semantics; a peak ratio on
+        a relative-from-zero rollout would be meaningless."""
         base = self.estimate(baseline_traffic, seed=seed)
         hypo = self.estimate(hypothetical_traffic, seed=seed + 1)
         factors = {}
-        for metric in base:
-            b = float(np.max(base[metric]["q50"]))
-            h = float(np.max(hypo[metric]["q50"]))
+        for e, metric in enumerate(self.predictor.metric_names):
+            bs, hs = base[metric]["q50"], hypo[metric]["q50"]
+            if self._is_relative(e):
+                b = float(np.max(bs) - bs[0])
+                h = float(np.max(hs) - hs[0])
+            else:
+                b = float(np.max(bs))
+                h = float(np.max(hs))
             factors[metric] = h / b if b > 0 else float("inf")
         return factors

@@ -10,12 +10,59 @@ explicit :class:`MinMaxStats` state instead of loose tuples.
 from __future__ import annotations
 
 import dataclasses
+from typing import Sequence
 
 import numpy as np
 
 from deeprest_tpu.config import TrainConfig
 from deeprest_tpu.data.featurize import FeaturizedData
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
+
+
+
+
+def delta_mask(metric_names: Sequence[str],
+               resources: Sequence[str]) -> np.ndarray:
+    """Boolean [E] mask of metrics (named ``component_resource``) whose
+    resource is trained in increment space."""
+    res = set(resources)
+    return np.asarray(
+        [name.rsplit("_", 1)[-1] in res for name in metric_names], bool)
+
+
+def to_increments(targets: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """[T, E] levels → per-bucket increments for the masked columns.
+
+    ``d[t] = y[t] − y[t−1]`` with ``d[0] = 0`` (the first bucket has no
+    predecessor; one bucket of a month-scale corpus).  Unmasked columns
+    pass through untouched."""
+    if not mask.any():
+        return targets
+    out = np.array(targets, np.float32, copy=True)
+    out[1:, mask] = targets[1:, mask] - targets[:-1, mask]
+    out[0, mask] = 0.0
+    return out
+
+
+def integrate_level_columns(preds: np.ndarray, mask: np.ndarray,
+                            anchors: np.ndarray | None = None) -> np.ndarray:
+    """Integrate per-bucket increment predictions back to levels.
+
+    ``preds``: ``[..., W, E]`` de-normalized window predictions whose
+    masked columns are increments.  The cumulative sum runs along the
+    window axis; with ``anchors`` (``[..., 1, E]`` levels, e.g. each
+    window's first observation) the integrated series is shifted so its
+    first element equals the anchor — the reference demo's re-anchoring
+    contract.  Without anchors the offset is arbitrary (callers that
+    re-anchor later, e.g. the what-if demo, pass None)."""
+    if not mask.any():
+        return preds
+    out = np.array(preds, copy=True)
+    c = np.cumsum(out[..., mask], axis=-2)
+    if anchors is not None:
+        c += anchors[..., mask] - c[..., :1, :]
+    out[..., mask] = c
+    return out
 
 
 @dataclasses.dataclass
@@ -35,6 +82,12 @@ class DatasetBundle:
     # into the checkpoint sidecar so serving-time featurization of raw
     # corpora is column-exact with the trained features.
     space_dict: dict | None = None
+    # [E] bool: metrics whose normalized targets are per-bucket increments
+    # (delta_resources); None for pre-delta bundles (restored checkpoints).
+    delta_mask: np.ndarray | None = None
+    # Raw LEVEL series [T, E] (pre-transform) — evaluation reconstructs
+    # level-space labels/predictions for the masked columns from these.
+    raw_targets: np.ndarray | None = None
 
     @property
     def num_metrics(self) -> int:
@@ -46,6 +99,39 @@ class DatasetBundle:
 
     def denorm_targets(self, y: np.ndarray) -> np.ndarray:
         return self.y_stats.invert(y)
+
+    # -- level-space reconstruction (delta-trained columns) -------------
+    # The single owner of the test-window delta→level contract, shared by
+    # trainer.evaluate and the CLI's plots so reported MAE and rendered
+    # curves cannot drift apart.
+
+    def _has_delta(self) -> bool:
+        return (self.delta_mask is not None and self.delta_mask.any()
+                and self.raw_targets is not None)
+
+    def _level_windows(self, idx: np.ndarray) -> np.ndarray:
+        """Raw level windows aligned with ``x_test[idx]``."""
+        return sliding_windows(
+            self.raw_targets, self.window_size)[self.split + np.asarray(idx)]
+
+    def level_labels(self, idx: np.ndarray) -> np.ndarray:
+        """De-normalized test labels with delta columns swapped for the
+        raw LEVEL windows."""
+        labels = self.denorm_targets(np.asarray(self.y_test[idx]))
+        if self._has_delta():
+            lvl = self._level_windows(idx)
+            labels[..., self.delta_mask] = lvl[..., self.delta_mask]
+        return labels
+
+    def integrate_test_preds(self, preds_denorm: np.ndarray,
+                             idx: np.ndarray) -> np.ndarray:
+        """Integrate delta columns of de-normalized test predictions from
+        each window's first observed level."""
+        if not self._has_delta():
+            return preds_denorm
+        return integrate_level_columns(
+            preds_denorm, self.delta_mask,
+            anchors=self._level_windows(idx)[:, :1])
 
 
 def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
@@ -59,10 +145,19 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
     is exactly equivalent: min/max over the train windows equals min/max
     over their union ``base[:split + w - 1]``, and scaling commutes with
     window selection.
+
+    Level-type resources (``config.delta_resources``, default disk usage)
+    are transformed to per-bucket increments BEFORE normalization: the
+    model learns what traffic *causes* (the change) instead of an
+    absolute level that encodes unseen history.  The bundle carries the
+    mask and the raw level series so evaluation/serving can integrate
+    predictions back (``integrate_level_columns``).
     """
     w = config.window_size
     traffic = data.traffic                        # [T, F]
-    targets = data.targets()                      # [T, E]
+    raw_targets = data.targets()                  # [T, E] level space
+    mask = delta_mask(data.metric_names, config.delta_resources)
+    targets = to_increments(raw_targets, mask)
     n_windows = len(traffic) - w
     if n_windows <= 0:
         raise ValueError(
@@ -95,6 +190,8 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
         split=split,
         window_size=w,
         space_dict=data.space.to_dict(),
+        delta_mask=mask,
+        raw_targets=raw_targets,
     )
 
 

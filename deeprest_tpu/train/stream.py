@@ -74,7 +74,7 @@ from deeprest_tpu.config import Config, FeaturizeConfig
 from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.data.schema import Bucket
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
-from deeprest_tpu.train.data import DatasetBundle
+from deeprest_tpu.train.data import DatasetBundle, delta_mask, to_increments
 from deeprest_tpu.train.trainer import Trainer, TrainState
 
 
@@ -359,6 +359,12 @@ class StreamingTrainer:
         self._warned_new_metrics: set[str] = set()
         self._pending = 0
         self._refresh_count = 0
+        # Set on resume: the delta mask the restored params were TRAINED
+        # with.  refresh() must keep using it — y_stats and params both
+        # encode the target space, so silently switching a resumed stream
+        # to this config's delta_resources would collapse the normalized
+        # range and cumsum level-scale outputs.
+        self._resumed_delta_mask: np.ndarray | None = None
         self._maybe_resume()
 
     # -- ingestion ------------------------------------------------------
@@ -408,7 +414,22 @@ class StreamingTrainer:
         """Fine-tune on the retained corpus; returns the refresh record."""
         w = self.config.train.window_size
         traffic = np.stack(list(self.traffic))
-        targets = self._targets()
+        raw_targets = self._targets()
+        # Level-type resources train as per-bucket increments (the same
+        # transform prepare_dataset applies — train/data.py).  Recomputed
+        # over the full retained series each refresh, so there is no
+        # cross-chunk carry to track; the deque holds raw levels.  A
+        # resumed stream keeps the mask its checkpoint was trained with
+        # (_maybe_resume) — the restored y_stats/params encode it.
+        dmask = delta_mask(self._freeze_metrics(),
+                           self.config.train.delta_resources)
+        if self._resumed_delta_mask is not None:
+            if not np.array_equal(dmask, self._resumed_delta_mask):
+                print("stream: config delta_resources disagrees with the "
+                      "resumed checkpoint's delta mask; keeping the "
+                      "checkpoint's (retrain from scratch to change it)")
+            dmask = self._resumed_delta_mask
+        targets = to_increments(raw_targets, dmask)
 
         x = sliding_windows(traffic, w)
         y = sliding_windows(targets, w)
@@ -445,6 +466,7 @@ class StreamingTrainer:
             x_stats=self.x_stats, y_stats=self.y_stats,
             metric_names=self._freeze_metrics(), split=split,
             window_size=w, space_dict=self.space.to_dict(),
+            delta_mask=dmask, raw_targets=raw_targets,
         )
 
         if self.trainer is None:
@@ -518,6 +540,20 @@ class StreamingTrainer:
         self.metric_names = list(extra["metric_names"])
         self.x_stats = MinMaxStats.from_dict(extra["x_stats"])
         self.y_stats = MinMaxStats.from_dict(extra["y_stats"])
+        # The delta mask the checkpoint was trained with.  Pre-delta
+        # sidecars have no key: those params predict absolute levels, so
+        # resume with the transform OFF rather than silently flipping the
+        # target semantics under restored y_stats/params.
+        dm = extra.get("delta_mask")
+        if dm is not None:
+            self._resumed_delta_mask = np.asarray(dm, bool)
+        else:
+            self._resumed_delta_mask = np.zeros(len(self.metric_names), bool)
+            if delta_mask(self.metric_names,
+                          self.config.train.delta_resources).any():
+                print("stream: checkpoint predates the delta formulation; "
+                      "resuming with absolute-level targets (retrain from "
+                      "scratch to adopt delta_resources)")
         # Old checkpoints predate the honest union; effective stats are the
         # closest available stand-in (slightly sticky for dead columns).
         self.x_union = MinMaxStats.from_dict(

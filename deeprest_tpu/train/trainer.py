@@ -218,12 +218,27 @@ class Trainer:
         preds_denorm = bundle.denorm_targets(
             np.maximum(np.asarray(preds[..., med]), 1e-6)
         )
-        labels_denorm = bundle.denorm_targets(np.asarray(bundle.y_test[idx]))
+
+        # Delta-trained columns come back as per-bucket increments: report
+        # them in LEVEL space — integrate the predictions from each
+        # window's first observed level, and swap the labels for the raw
+        # level windows (bundle.level_labels / integrate_test_preds, the
+        # single owner of that contract).  Baseline predictions (already
+        # levels) are re-anchored to the same window anchor, so every
+        # method is compared on shape from a shared anchor — the reference
+        # demo's semantics for these series (web-demo/dataloader.py:143-156).
+        mask = bundle.delta_mask
+        labels_denorm = bundle.level_labels(idx)
+        preds_denorm = bundle.integrate_test_preds(preds_denorm, idx)
 
         errors = {"deepr": np.abs(preds_denorm - labels_denorm)}
         if baseline_preds:
             for method, series in baseline_preds.items():
-                errors[method] = np.abs(np.asarray(series)[idx] - labels_denorm)
+                series = np.array(np.asarray(series)[idx], copy=True)
+                if bundle._has_delta():
+                    series[..., mask] += (labels_denorm[:, :1, mask]
+                                          - series[:, :1, mask])
+                errors[method] = np.abs(series - labels_denorm)
         return float(loss), mae_report(errors, bundle.metric_names)
 
     # ------------------------------------------------------------------
@@ -275,6 +290,10 @@ class Trainer:
             "feature_dim": bundle.feature_dim,
             "model_config": dataclasses.asdict(self.model_config),
             "space": bundle.space_dict,
+            # Which metrics the model predicts as per-bucket increments —
+            # serving must integrate these back to levels (predictor.py).
+            "delta_mask": (np.asarray(bundle.delta_mask, bool).tolist()
+                           if bundle.delta_mask is not None else None),
         }
         if extra_host_state:
             clash = set(extra_host_state) & set(extra)
