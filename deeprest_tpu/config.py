@@ -88,6 +88,14 @@ class TrainConfig:
     # anchor at eval/serve time (train/data.py:integrate_level_columns).
     # Empty tuple disables the delta formulation entirely.
     delta_resources: tuple[str, ...] = LEVEL_RESOURCES
+    # Device-resident input pipeline: "auto" stages the normalized BASE
+    # series in HBM (bf16 for bf16 models) when it fits the byte budget,
+    # and each train step gathers its windows by start index — per-step
+    # host→device traffic becomes [B] int32 instead of the [B,W,F] window
+    # tensor (windows overlap W−1 of W rows; materialized shipping
+    # re-sends every row W times).  "off" always streams from host.
+    device_data: str = "auto"
+    device_data_max_bytes: int = 4 << 30
 
 
 @dataclasses.dataclass(frozen=True)

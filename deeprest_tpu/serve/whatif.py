@@ -79,10 +79,15 @@ class WhatIfEstimator:
         for e, metric in enumerate(self.predictor.metric_names):
             bs, hs = base[metric]["q50"], hypo[metric]["q50"]
             if self._is_relative(e):
-                b = float(np.max(bs) - bs[0])
-                h = float(np.max(hs) - hs[0])
+                # Growth can legitimately be ~0 (a program driving no
+                # writes): clamp at 0 and define 0-growth/0-growth as 1.0
+                # (no change) instead of letting inf leak into bar charts.
+                b = max(float(np.max(bs) - bs[0]), 0.0)
+                h = max(float(np.max(hs) - hs[0]), 0.0)
+                factors[metric] = (h / b if b > 0
+                                   else (1.0 if h == 0 else float("inf")))
             else:
                 b = float(np.max(bs))
                 h = float(np.max(hs))
-            factors[metric] = h / b if b > 0 else float("inf")
+                factors[metric] = h / b if b > 0 else float("inf")
         return factors

@@ -88,6 +88,13 @@ class DatasetBundle:
     # Raw LEVEL series [T, E] (pre-transform) — evaluation reconstructs
     # level-space labels/predictions for the masked columns from these.
     raw_targets: np.ndarray | None = None
+    # Normalized BASE series [T, F]/[T, E] the windows are strided views
+    # of.  The device-resident feed (Trainer.stage_dataset) ships these to
+    # HBM once and gathers windows on device by start index — windows
+    # overlap W−1 of W rows, so shipping materialized windows per step
+    # re-sends the same bytes W times (the 10k-wide host-feed wall).
+    x_base: np.ndarray | None = None
+    y_base: np.ndarray | None = None
 
     @property
     def num_metrics(self) -> int:
@@ -192,6 +199,8 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
         space_dict=data.space.to_dict(),
         delta_mask=mask,
         raw_targets=raw_targets,
+        x_base=x_n,
+        y_base=y_n,
     )
 
 

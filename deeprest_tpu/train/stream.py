@@ -467,6 +467,8 @@ class StreamingTrainer:
             metric_names=self._freeze_metrics(), split=split,
             window_size=w, space_dict=self.space.to_dict(),
             delta_mask=dmask, raw_targets=raw_targets,
+            x_base=self.x_stats.apply(traffic).astype(np.float32),
+            y_base=self.y_stats.apply(targets).astype(np.float32),
         )
 
         if self.trainer is None:
@@ -482,9 +484,13 @@ class StreamingTrainer:
         data_rng = np.random.default_rng(
             self.config.train.seed + self._refresh_count)
         train_loss = float("nan")
+        # Device-resident feed for the fine-tune epochs: the staged base
+        # is W× less transfer than shipping overlapping windows even for
+        # a single epoch (re-staged each refresh — the series grew).
+        staged = self.trainer.stage_dataset(bundle)
         for _ in range(self.stream.finetune_epochs):
             self.state, train_loss = self.trainer.train_epoch(
-                self.state, bundle, data_rng)
+                self.state, bundle, data_rng, staged=staged)
         eval_loss, _ = self.trainer.evaluate(self.state, bundle)
 
         path = None

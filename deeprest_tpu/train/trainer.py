@@ -29,7 +29,7 @@ from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
-    feed_replicated, gather_to_host, prefetch_to_device,
+    feed_global_batch, feed_replicated, gather_to_host, prefetch_to_device,
 )
 from deeprest_tpu.parallel.mesh import make_mesh
 from deeprest_tpu.parallel.sharding import shard_params
@@ -91,12 +91,24 @@ class Trainer:
                 loss,
             )
 
+        def train_step_indexed(state: TrainState, x_base, y_base, starts, wb):
+            # Device-resident feed: the normalized BASE series live in HBM
+            # (stage_dataset) and each step gathers its windows by start
+            # index — per-step host→device traffic is [B] int32 + weights
+            # instead of the [B,W,F] window tensor (windows overlap W−1 of
+            # W rows, so materialized shipping re-sends every row W times;
+            # at F=10240 over the tunneled chip that was a 200× feed gap).
+            w = self.config.train.window_size
+            idx = starts[:, None] + jnp.arange(w)[None, :]    # [B, W]
+            return train_step(state, x_base[idx], y_base[idx], wb)
+
         def eval_step(params, xb, yb):
             preds = self.model.apply({"params": params}, xb, deterministic=True)
             loss = pinball_loss(preds, yb, quantiles)
             return preds, loss
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._train_step_indexed = jax.jit(train_step_indexed, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(
             lambda params, xb: self.model.apply(
@@ -136,25 +148,78 @@ class Trainer:
                 sel = np.concatenate([sel, np.resize(order, bs - len(sel))])
             yield sel, weight
 
+    def stage_dataset(self, bundle: DatasetBundle):
+        """Ship the normalized base series to HBM for index-gather feeding.
+
+        Returns ``(x_base, y_base)`` device arrays (replicated over the
+        mesh) or None when staging is off, the bundle predates base-series
+        capture, or the series exceed ``device_data_max_bytes`` ("auto").
+        For bf16 models ``x_base`` stages in bf16 — the model casts inputs
+        there anyway, and it halves both HBM residency and the one-time
+        transfer (885 MB for a month at F=10240).
+        """
+        cfg = self.config.train
+        if cfg.device_data not in ("auto", "off"):
+            raise ValueError(
+                f"TrainConfig.device_data={cfg.device_data!r}: must be "
+                f"'auto' or 'off' (an unknown value silently skipping the "
+                f"byte budget could OOM the chip)")
+        if (cfg.device_data == "off" or bundle.x_base is None
+                or bundle.y_base is None):
+            return None
+        x = np.asarray(bundle.x_base)
+        if jnp.dtype(self.model_config.compute_dtype) == jnp.bfloat16:
+            import ml_dtypes
+
+            x = x.astype(ml_dtypes.bfloat16)
+        total = x.nbytes + bundle.y_base.nbytes
+        if cfg.device_data == "auto" and total > cfg.device_data_max_bytes:
+            return None
+        return (feed_replicated(self.mesh, x),
+                feed_replicated(self.mesh, np.asarray(bundle.y_base)))
+
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
-                    epoch_rng: np.random.Generator) -> tuple[TrainState, float]:
+                    epoch_rng: np.random.Generator,
+                    staged=None) -> tuple[TrainState, float]:
         log_every = self.config.train.log_every_steps
         losses = []
         steps = 0
         measuring = self._warmed
         if measuring:
             self.throughput.start()
-        def host_batches():
-            # feed_global_batch (inside prefetch): sharded device_put on one
-            # host; on a pod, each process ships only its process_batch_slice
-            # of the (identical, rng-deterministic) global selection.
-            for sel, weight in self._batches(len(bundle.x_train), epoch_rng):
-                yield bundle.x_train[sel], bundle.y_train[sel], weight
+        if staged is None:
+            def host_batches():
+                # feed_global_batch (inside prefetch): sharded device_put on
+                # one host; on a pod, each process ships only its
+                # process_batch_slice of the (identical, rng-deterministic)
+                # global selection.
+                for sel, weight in self._batches(len(bundle.x_train),
+                                                 epoch_rng):
+                    yield bundle.x_train[sel], bundle.y_train[sel], weight
 
-        for xb, yb, wb in prefetch_to_device(
-                self.mesh, host_batches(),
-                depth=self.config.train.prefetch_depth):
-            state, loss = self._train_step(state, xb, yb, wb)
+            batches = prefetch_to_device(self.mesh, host_batches(),
+                                         depth=self.config.train.prefetch_depth)
+            run = self._train_step
+        else:
+            x_base, y_base = staged
+
+            def index_batches():
+                # Train window i starts at base row i (stride-1 windows),
+                # so the shuffled selection IS the start-index batch.
+                for sel, weight in self._batches(len(bundle.x_train),
+                                                 epoch_rng):
+                    yield (feed_global_batch(self.mesh,
+                                             sel.astype(np.int32),
+                                             axes=("data",)),
+                           feed_global_batch(self.mesh, weight,
+                                             axes=("data",)))
+
+            batches = index_batches()
+            run = lambda st, starts, wb: self._train_step_indexed(
+                st, x_base, y_base, starts, wb)
+
+        for batch in batches:
+            state, loss = run(state, *batch)
             losses.append(loss)
             self._global_step += 1
             if not self._warmed:
@@ -257,8 +322,10 @@ class Trainer:
         data_rng = np.random.default_rng(cfg.seed)
         history: list[EpochResult] = []
         total = num_epochs if num_epochs is not None else cfg.num_epochs
+        staged = self.stage_dataset(bundle) if total else None
         for epoch in range(total):
-            state, train_loss = self.train_epoch(state, bundle, data_rng)
+            state, train_loss = self.train_epoch(state, bundle, data_rng,
+                                                 staged=staged)
             test_loss, report = self.evaluate(state, bundle, baseline_preds)
             result = EpochResult(epoch=epoch, train_loss=train_loss,
                                  test_loss=test_loss, report=report)

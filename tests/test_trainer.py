@@ -226,3 +226,36 @@ def test_prepare_dataset_windows_are_views_not_copies():
     # and batch selection still copies just the batch
     sel = b.x_train[[0, 5, 2]]
     assert sel.base is None or not np.shares_memory(sel, b.x_train)
+
+
+@pytest.mark.slow
+def test_device_resident_feed_matches_host_feed(bundle):
+    """The index-gather feed (staged base series in device memory) must
+    train BIT-IDENTICALLY to the host window-shipping path for f32 models:
+    the gathered rows are the same values, the step code is shared, and
+    the shuffled selection is the same rng stream."""
+    import dataclasses
+
+    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    staged = trainer.stage_dataset(bundle)
+    assert staged is not None           # base series captured by prepare_dataset
+
+    rng_a, rng_b = np.random.default_rng(7), np.random.default_rng(7)
+    s_host = trainer.init_state(bundle.x_train, seed=3)
+    s_dev = trainer.init_state(bundle.x_train, seed=3)
+    s_host, loss_h = trainer.train_epoch(s_host, bundle, rng_a)
+    s_dev, loss_d = trainer.train_epoch(s_dev, bundle, rng_b, staged=staged)
+    assert loss_h == loss_d
+    for a, b in zip(jax.tree.leaves(s_host.params), jax.tree.leaves(s_dev.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # device_data="off" (and pre-base bundles) fall back to host streaming
+    off = Config(model=SMALL.model,
+                 train=dataclasses.replace(SMALL.train, device_data="off"))
+    assert Trainer(off, bundle.feature_dim,
+                   bundle.metric_names).stage_dataset(bundle) is None
+    tiny = Config(model=SMALL.model,
+                  train=dataclasses.replace(SMALL.train,
+                                            device_data_max_bytes=8))
+    assert Trainer(tiny, bundle.feature_dim,
+                   bundle.metric_names).stage_dataset(bundle) is None
