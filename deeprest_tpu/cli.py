@@ -124,8 +124,11 @@ def cmd_ingest(args) -> int:
 
     The adapter for pointing the estimator at an EXISTING instrumented
     cluster (reference input contract: resource-estimation/README.md:29-63)
-    instead of this framework's own collector."""
-    from deeprest_tpu.data.ingest import MetricRule, ingest_files
+    instead of this framework's own collector.  Sources: trace/metric dump
+    FILES (--traces/--prom) or LIVE endpoints (--jaeger-url/--prom-url
+    with a time range) — the reference deploys live Jaeger + Prometheus
+    services (k8s-yaml/tracing/run.yaml; monitor-openebs-pg.yaml)."""
+    from deeprest_tpu.data.ingest import MetricRule, ingest_files, ingest_live
     from deeprest_tpu.data.schema import save_raw_data_jsonl
 
     resource_map = None
@@ -148,8 +151,33 @@ def cmd_ingest(args) -> int:
                       "(must be 'gauge' or 'counter')")
                 return 2
             resource_map[prom_name] = MetricRule(resource, mode)
-    buckets = ingest_files(args.traces, args.prom or [], args.bucket_seconds,
-                           resource_map=resource_map)
+    live = bool(args.jaeger_url or args.prom_url)
+    if live and (args.traces or args.prom):
+        print("ingest: --traces/--prom dumps and --jaeger-url/--prom-url "
+              "are mutually exclusive sources")
+        return 2
+    if not live and not args.traces:
+        print("ingest: need either --traces dump files or a live "
+              "--jaeger-url/--prom-url")
+        return 2
+    if live:
+        import time as _time
+
+        end_s = args.end if args.end is not None else _time.time()
+        start_s = (args.start if args.start is not None
+                   else end_s - args.last_seconds)
+        if start_s >= end_s:
+            print(f"ingest: empty time range [{start_s}, {end_s})")
+            return 2
+        buckets = ingest_live(
+            args.jaeger_url, args.prom_url, start_s, end_s,
+            args.bucket_seconds, step_s=args.step_seconds,
+            resource_map=resource_map,
+            services=args.jaeger_services or None)
+    else:
+        buckets = ingest_files(args.traces, args.prom or [],
+                               args.bucket_seconds,
+                               resource_map=resource_map)
     if not buckets:
         print("ingest: no buckets produced (empty dumps or disjoint ranges)")
         return 1
@@ -315,15 +343,22 @@ def cmd_synthesize(args) -> int:
 
 
 def cmd_stream(args) -> int:
-    """Continuous retrain: tail a growing raw-data JSONL, fine-tune, and
-    re-checkpoint (BASELINE.json config 5; train/stream.py docstring has
-    the drift-handling design)."""
+    """Continuous retrain: tail a growing raw-data JSONL — or poll live
+    Jaeger/Prometheus endpoints — fine-tune, and re-checkpoint
+    (BASELINE.json config 5; train/stream.py docstring has the
+    drift-handling design)."""
     from deeprest_tpu.config import (
         Config, FeaturizeConfig, ModelConfig, TrainConfig,
     )
     from deeprest_tpu.train.stream import (
         BucketTailer, StreamConfig, StreamingTrainer,
     )
+
+    live = bool(args.jaeger_url or args.prom_url)
+    if live == bool(args.raw):
+        print("stream: need exactly one source — either --raw JSONL or "
+              "live --jaeger-url/--prom-url endpoints")
+        return 2
 
     cfg = Config(
         model=ModelConfig(feature_dim=args.capacity,
@@ -347,7 +382,14 @@ def cmd_stream(args) -> int:
                                        capacity=args.capacity,
                                        hash_seed=args.hash_seed),
     )
-    tailer = BucketTailer(args.raw)
+    if live:
+        from deeprest_tpu.data.ingest import LiveEndpointTailer
+
+        tailer = LiveEndpointTailer(
+            jaeger_url=args.jaeger_url, prom_url=args.prom_url,
+            bucket_s=args.bucket_seconds)
+    else:
+        tailer = BucketTailer(args.raw)
     for r in st.run(tailer,
                     max_refreshes=args.max_refreshes or None,
                     deadline_s=args.deadline or None):
@@ -570,11 +612,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "ingest",
-        help="Jaeger/OTLP + Prometheus dumps → raw corpus JSONL")
-    p.add_argument("--traces", nargs="+", required=True,
+        help="Jaeger/OTLP + Prometheus (dumps or live endpoints) → raw "
+             "corpus JSONL")
+    p.add_argument("--traces", nargs="*", default=[],
                    help="Jaeger query-API or OTLP/JSON trace dump files")
     p.add_argument("--prom", nargs="*", default=[],
                    help="Prometheus query_range JSON dump files")
+    p.add_argument("--jaeger-url", default=None,
+                   help="live Jaeger query API base URL (e.g. "
+                        "http://jaeger-query:16686)")
+    p.add_argument("--prom-url", default=None,
+                   help="live Prometheus base URL (e.g. "
+                        "http://prometheus:9090)")
+    p.add_argument("--start", type=float, default=None,
+                   help="live pull range start (epoch seconds; default "
+                        "end - --last-seconds)")
+    p.add_argument("--end", type=float, default=None,
+                   help="live pull range end (epoch seconds; default now)")
+    p.add_argument("--last-seconds", type=float, default=3600.0,
+                   help="live pull lookback when --start is omitted")
+    p.add_argument("--step-seconds", type=float, default=None,
+                   help="Prometheus query_range step (default: the bucket "
+                        "width — scrape interval = bucket contract)")
+    p.add_argument("--jaeger-services", nargs="*", default=None,
+                   help="restrict the live Jaeger pull to these services "
+                        "(default: discover via /api/services)")
     p.add_argument("--bucket-seconds", type=float, default=5.0,
                    help="discretization window (= the cluster's scrape "
                         "interval; the reference scrapes at 5s)")
@@ -624,10 +686,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_synthesize)
 
     p = sub.add_parser("stream",
-                       help="tail a growing raw corpus; fine-tune + "
-                            "re-checkpoint continuously")
-    p.add_argument("--raw", required=True,
+                       help="tail a growing raw corpus (or poll live "
+                            "Jaeger/Prometheus); fine-tune + re-checkpoint "
+                            "continuously")
+    p.add_argument("--raw", default=None,
                    help="raw-data JSONL being appended to (collector --out)")
+    p.add_argument("--jaeger-url", default=None,
+                   help="live Jaeger query API base URL (alternative "
+                        "source to --raw)")
+    p.add_argument("--prom-url", default=None,
+                   help="live Prometheus base URL (alternative source "
+                        "to --raw)")
+    p.add_argument("--bucket-seconds", type=float, default=5.0,
+                   help="live-source discretization window (= scrape "
+                        "interval)")
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--capacity", type=int, default=512,
                    help="hash-feature width (static model input dim)")

@@ -349,6 +349,231 @@ def bucketize(
 
 
 # ---------------------------------------------------------------------------
+# live-endpoint pull (Jaeger query API + Prometheus HTTP API)
+# ---------------------------------------------------------------------------
+#
+# The reference deploys LIVE Jaeger and Prometheus services
+# (social-network-deploy/k8s-yaml/tracing/run.yaml:1-18;
+# minikube-openebs/monitor-openebs-pg.yaml:38-173) — file dumps were the
+# hand-carried stopgap.  These pullers speak the same HTTP APIs those
+# deployments expose, with time-range pagination, feeding the same
+# ``bucketize``; stdlib urllib only (no client-library dependency).
+
+
+def _http_get_json(url: str, timeout_s: float = 30.0):
+    import urllib.request
+
+    req = urllib.request.Request(url, headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def pull_jaeger(
+    base_url: str,
+    start_s: float,
+    end_s: float,
+    services: Sequence[str] | None = None,
+    limit: int = 1000,
+    timeout_s: float = 30.0,
+    min_slice_s: float = 1.0,
+    fetch=_http_get_json,
+) -> list[tuple[float, Span]]:
+    """Pull span trees from a live Jaeger query API over ``[start_s, end_s)``.
+
+    Jaeger's HTTP API (``GET /api/traces?service=..&start=..&end=..``,
+    microsecond timestamps) has no result cursor — only ``limit``.
+    Pagination is therefore TIME-SLICING: when a slice returns ``limit``
+    traces (the truncation signal), it is split in half and both halves
+    re-queried, down to ``min_slice_s`` (below that the slice is accepted
+    with a warning — better a bounded loss surfaced than an unbounded
+    recursion).  ``services=None`` discovers the service list from
+    ``/api/services``.  Traces are deduplicated by traceID across slices
+    and services (a trace spanning services returns for each).
+    """
+    base = base_url.rstrip("/")
+    if services is None:
+        payload = fetch(f"{base}/api/services", timeout_s)
+        services = [str(s) for s in (payload.get("data") or [])]
+    from urllib.parse import urlencode
+
+    seen: set[str] = set()
+    out: list[tuple[float, Span]] = []
+    for service in services:
+        slices = [(start_s, end_s)]
+        while slices:
+            lo, hi = slices.pop()
+            if hi <= lo:
+                continue
+            q = urlencode({"service": service, "start": int(lo * 1e6),
+                           "end": int(hi * 1e6), "limit": limit})
+            payload = fetch(f"{base}/api/traces?{q}", timeout_s)
+            traces = payload.get("data") or []
+            if len(traces) >= limit and (hi - lo) > min_slice_s:
+                mid = (lo + hi) / 2.0
+                slices += [(lo, mid), (mid, hi)]
+                continue
+            if len(traces) >= limit:
+                import warnings
+
+                warnings.warn(
+                    f"jaeger slice [{lo}, {hi}) for {service!r} still hits "
+                    f"limit={limit} at the {min_slice_s}s floor — some "
+                    f"traces in this slice are not retrievable")
+            fresh = [t for t in traces
+                     if t.get("traceID") not in seen]
+            for t in fresh:
+                tid = t.get("traceID")
+                if tid is not None:
+                    seen.add(tid)
+            out.extend(jaeger_traces(fresh))
+    out.sort(key=lambda p: p[0])
+    return out
+
+
+def pull_prometheus(
+    base_url: str,
+    start_s: float,
+    end_s: float,
+    step_s: float,
+    resource_map: Mapping[str, MetricRule] | None = None,
+    timeout_s: float = 30.0,
+    max_points: int = 10_000,
+    fetch=_http_get_json,
+) -> list[tuple[float, str, str, float, str, str]]:
+    """Pull metric samples from a live Prometheus over ``[start_s, end_s]``.
+
+    One ``/api/v1/query_range`` per metric in ``resource_map``, chunked so
+    each request stays under ``max_points`` samples per series (Prometheus
+    rejects ranges over ~11k points).  Chunk boundaries are inclusive on
+    both ends, so boundary samples are deduplicated by (series, ts).
+    """
+    from urllib.parse import urlencode
+
+    base = base_url.rstrip("/")
+    rmap = DEFAULT_RESOURCE_MAP if resource_map is None else resource_map
+    if step_s <= 0:
+        raise ValueError(f"step_s must be positive, got {step_s}")
+    span = max_points * step_s
+    dedup: dict[tuple[str, float, str], tuple] = {}
+    for metric in rmap:
+        lo = start_s
+        while True:
+            hi = min(lo + span, end_s)
+            q = urlencode({"query": metric, "start": lo, "end": hi,
+                           "step": step_s})
+            payload = fetch(f"{base}/api/v1/query_range?{q}", timeout_s)
+            for s in prometheus_series(payload, resource_map=rmap):
+                dedup[(s[5], s[0], s[2])] = s
+            if hi >= end_s:
+                break
+            # Chunks OVERLAP at the boundary instant (the dedup key
+            # absorbs the duplicate) — advancing past ``hi`` would skip
+            # samples between evaluations.
+            lo = hi
+    return sorted(dedup.values(), key=lambda s: s[0])
+
+
+def ingest_live(
+    jaeger_url: str | None,
+    prom_url: str | None,
+    start_s: float,
+    end_s: float,
+    bucket_s: float,
+    step_s: float | None = None,
+    resource_map: Mapping[str, MetricRule] | None = None,
+    services: Sequence[str] | None = None,
+    timeout_s: float = 30.0,
+    fetch=_http_get_json,
+) -> list[Bucket]:
+    """Pull ``[start_s, end_s)`` from live Jaeger/Prometheus endpoints and
+    discretize into the ordered bucket list (``bucketize``).  ``step_s``
+    defaults to the bucket width — the scrape-interval-as-bucket contract
+    (reference: resource-estimation/README.md:29)."""
+    traces = (pull_jaeger(jaeger_url, start_s, end_s, services=services,
+                          timeout_s=timeout_s, fetch=fetch)
+              if jaeger_url else [])
+    samples = (pull_prometheus(prom_url, start_s, end_s,
+                               step_s if step_s is not None else bucket_s,
+                               resource_map=resource_map,
+                               timeout_s=timeout_s, fetch=fetch)
+               if prom_url else [])
+    if not traces and not samples:
+        return []
+    # t1 a fraction of a bucket inside the end so an exactly-aligned range
+    # keeps [start, end) semantics instead of growing a trailing empty
+    # bucket.  The margin is bucket-relative (not absolute 1e-9: epoch
+    # timestamps have float ulp ~2.4e-7, which would swallow it); sample
+    # placement is unaffected — only the range arithmetic sees t1.
+    return bucketize(traces, samples, bucket_s, t0=start_s,
+                     t1=max(start_s, end_s - 1e-3 * bucket_s))
+
+
+class LiveEndpointTailer:
+    """A ``BucketTailer``-protocol source polling live Jaeger/Prometheus.
+
+    Each ``poll()`` pulls the closed bucket range since the previous poll
+    (aligned down to whole buckets, ``lag_s`` behind the clock so
+    scrape/collection stragglers land before their bucket is read) and
+    returns it as Buckets — plugging a live cluster straight into
+    ``StreamingTrainer.run`` with no hand-carried dumps.
+    """
+
+    backlog = False     # the pull is always caught up to now - lag
+
+    def __init__(self, jaeger_url: str | None = None,
+                 prom_url: str | None = None, bucket_s: float = 5.0,
+                 step_s: float | None = None,
+                 resource_map: Mapping[str, MetricRule] | None = None,
+                 services: Sequence[str] | None = None,
+                 lag_s: float | None = None, timeout_s: float = 30.0,
+                 now=None, fetch=_http_get_json):
+        if not jaeger_url and not prom_url:
+            raise ValueError("need at least one of jaeger_url/prom_url")
+        import time as _time
+
+        self.jaeger_url = jaeger_url
+        self.prom_url = prom_url
+        self.bucket_s = bucket_s
+        self.step_s = step_s
+        self.resource_map = resource_map
+        self.services = services
+        self.lag_s = 2 * bucket_s if lag_s is None else lag_s
+        self.timeout_s = timeout_s
+        self._now = now if now is not None else _time.time
+        self._fetch = fetch
+        # Start at the previous whole bucket so the first poll returns at
+        # most one bucket instead of an unbounded history backfill.
+        self._cursor = (math.floor((self._now() - self.lag_s) / bucket_s)
+                        * bucket_s)
+
+    def poll(self) -> list[Bucket]:
+        edge = (math.floor((self._now() - self.lag_s) / self.bucket_s)
+                * self.bucket_s)
+        if edge <= self._cursor:
+            return []
+        try:
+            # Pull ONE lead-in bucket before the cursor and drop it: a
+            # counter's per-bucket increase needs a base sample BEFORE the
+            # first reported bucket — without the lead-in, every poll's
+            # first bucket (i.e. every bucket, at one-bucket-per-poll
+            # cadence) would re-establish bases and stream counters as 0.
+            buckets = ingest_live(
+                self.jaeger_url, self.prom_url,
+                self._cursor - self.bucket_s, edge,
+                self.bucket_s, step_s=self.step_s,
+                resource_map=self.resource_map, services=self.services,
+                timeout_s=self.timeout_s, fetch=self._fetch)[1:]
+        except Exception as exc:   # endpoint blip: retry the SAME range
+            print(f"live ingest: pull failed ({exc}); will retry")
+            return []
+        self._cursor = edge
+        return buckets
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # file-level convenience (the CLI's ingest surface)
 # ---------------------------------------------------------------------------
 

@@ -168,13 +168,17 @@ class Trainer:
                 or bundle.y_base is None):
             return None
         x = np.asarray(bundle.x_base)
-        if jnp.dtype(self.model_config.compute_dtype) == jnp.bfloat16:
+        bf16 = jnp.dtype(self.model_config.compute_dtype) == jnp.bfloat16
+        # Budget check BEFORE the cast: the over-budget case is exactly the
+        # multi-GB corpus where a host-side bf16 copy would hurt most.
+        staged_x_bytes = x.size * 2 if bf16 else x.nbytes
+        total = staged_x_bytes + bundle.y_base.nbytes
+        if cfg.device_data == "auto" and total > cfg.device_data_max_bytes:
+            return None
+        if bf16:
             import ml_dtypes
 
             x = x.astype(ml_dtypes.bfloat16)
-        total = x.nbytes + bundle.y_base.nbytes
-        if cfg.device_data == "auto" and total > cfg.device_data_max_bytes:
-            return None
         return (feed_replicated(self.mesh, x),
                 feed_replicated(self.mesh, np.asarray(bundle.y_base)))
 
