@@ -363,6 +363,7 @@ def _maybe_pallas_proof(platform: str) -> dict | None:
     if platform == "cpu":
         return None
     out_path = os.path.join(REPO, "benchmarks", "pallas_tpu_result.json")
+    t_start = time.time()
     try:
         subprocess.run(
             [sys.executable, os.path.join(REPO, "benchmarks", "pallas_tpu_check.py"),
@@ -374,13 +375,23 @@ def _maybe_pallas_proof(platform: str) -> dict | None:
     except Exception as exc:  # best-effort: never sink the headline number
         print(f"bench: pallas proof failed: {exc}", file=sys.stderr)
         # The check script writes its findings (incl. a numerics failure)
-        # before exiting nonzero — keep that evidence if it exists.
+        # before exiting nonzero — keep that evidence IF it came from this
+        # run.  A file older than the run start is a PRIOR round's result
+        # (the check died before writing): label it, don't let a reader
+        # take stale numerics as validated by the run that errored.
         try:
+            stale = os.path.getmtime(out_path) < t_start
             with open(out_path, encoding="utf-8") as f:
                 result = json.load(f)
             result["error"] = str(exc)[:300]
+            if stale:
+                result["stale"] = ("numerics below are from a PRIOR run "
+                                   "(file predates this bench); this run's "
+                                   "check failed before writing")
             return result
-        except OSError:
+        except (OSError, ValueError):
+            # missing file OR truncated/corrupt JSON (a check killed
+            # mid-write) — never sink the headline over the proof record
             return {"error": str(exc)[:300]}
 
 

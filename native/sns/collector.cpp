@@ -89,7 +89,21 @@ ProcSample ReadProc(int pid) {
           if (l.rfind("btime ", 0) == 0) return std::stod(l.substr(6));
         return 0.0;
       }();
-      if (btime > 0) s.start_epoch_s = btime + std::stod(toks[19]) / hz;
+      if (btime > 0) {
+        s.start_epoch_s = btime + std::stod(toks[19]) / hz;
+      } else {
+        // Degraded mode, surfaced once (the io_ok path already warns):
+        // without btime, in-window process starts cannot be verified, so
+        // a genuinely newborn member's first-window cpu/write counters
+        // are dropped rather than attributed.
+        static const bool warned = [] {
+          SNS_LOG(LogLevel::Warning,
+                  "/proc/stat btime unreadable — newborn first-window "
+                  "attribution disabled (start times unverifiable)");
+          return true;
+        }();
+        (void)warned;
+      }
     }
   }
   {

@@ -18,11 +18,24 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import sys
 
 _axon_site = os.environ.get("DEEPREST_AXON_SITE", "/root/.axon_site")
-sys.path[:] = [p for p in sys.path if _axon_site not in p]
-if _axon_site in os.environ.get("PYTHONPATH", ""):
-    os.environ["PYTHONPATH"] = os.pathsep.join(
-        p for p in os.environ["PYTHONPATH"].split(os.pathsep)
-        if p and _axon_site not in p)
+if _axon_site:
+    # Prefix comparison on normalized paths, not substring membership: an
+    # empty DEEPREST_AXON_SITE would substring-match every entry and wipe
+    # sys.path entirely, and a path merely CONTAINING the site string must
+    # not be dropped.
+    _site = os.path.abspath(_axon_site)
+
+    def _under_site(p: str) -> bool:
+        ap = os.path.abspath(p or ".")
+        return ap == _site or ap.startswith(_site + os.sep)
+
+    sys.path[:] = [p for p in sys.path if not _under_site(p)]
+    _pp = os.environ.get("PYTHONPATH", "")
+    if _pp and any(_under_site(p) for p in _pp.split(os.pathsep) if p):
+        # Rewrite ONLY when the site is actually present: rejoining always
+        # would drop empty entries (implicit cwd for child interpreters).
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in _pp.split(os.pathsep) if p and not _under_site(p))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
