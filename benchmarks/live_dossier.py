@@ -1,0 +1,299 @@
+"""Non-simulator accuracy triangulation (VERDICT r4 weak #4).
+
+The month-scale dossier's corpus comes from the repo's own workload
+simulator — legitimate, but the win criterion is then "beats baselines on
+data whose generative process the builder controls".  This script
+triangulates with two independent sources:
+
+1. **Live-cluster corpus**: boots the REAL native microservice app
+   (native/sns — actual processes serving actual RPCs with durable WAL
+   stores), drives it with the load generator, and collects the
+   collector's cgroup/proc-measured telemetry.  The model and both
+   reference baselines then train/fit on the same split of that measured
+   corpus and compare MAE on held-out windows — the reference's own
+   experimental design (drive DeathStarBench, collect, estimate), at
+   laptop scale.
+2. **Reference toy fixture**: featurizes the reference repo's own
+   3-bucket ``raw_data.pkl`` and (when the reference code is importable)
+   compares the traffic/invocation matrices against the reference
+   featurizer as an oracle — schema-level sanity that our pipeline reads
+   the published contract byte-for-byte.
+
+Results land in ``benchmarks/live_dossier.json`` and are spliced into
+``ACCURACY.md`` between LIVE-DOSSIER markers (idempotent), so the dossier
+carries a non-simulator section.
+
+Run:  python benchmarks/live_dossier.py [--seconds 300] [--window 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BEGIN = "<!-- LIVE-DOSSIER:BEGIN -->"
+END = "<!-- LIVE-DOSSIER:END -->"
+REF_PICKLE = "/root/reference/resource-estimation/raw_data.pkl"
+
+
+def collect_live_corpus(out_path: str, seconds: float, interval_ms: int,
+                        users_scale: float = 0.08, seed: int = 0):
+    """Boot the native cluster, drive it, return the collected buckets."""
+    from deeprest_tpu.data.schema import load_raw_data
+    from deeprest_tpu.loadgen.cluster import SnsCluster, snsd_available
+    from deeprest_tpu.loadgen.graph import synthetic_social_graph
+    from deeprest_tpu.loadgen.runner import LoadRunner, RunnerConfig
+    from deeprest_tpu.loadgen.warmup import warmup
+    from deeprest_tpu.workload.scenarios import normal_scenario
+
+    if not snsd_available():
+        raise SystemExit("snsd not built — run `make -C native/sns` first")
+    data_dir = out_path + ".pvc"
+    graph = synthetic_social_graph(32, seed=1)
+    scenario = normal_scenario(seed)
+    tick_s = 0.7
+    with SnsCluster(out_path=out_path, interval_ms=interval_ms,
+                    grace_ms=300, data_dir=data_dir) as cluster:
+        stats = warmup(*cluster.gateway_addr, graph)
+        runner = LoadRunner(
+            cluster.gateway_addr, graph, scenario,
+            RunnerConfig(tick_seconds=tick_s, think_time=(0.02, 0.08),
+                         user_scale=users_scale, seed=seed),
+            media_addr=cluster.media_addr,
+        )
+        # run() takes a TICK count; convert so the wall duration matches
+        # what the dossier reports.
+        run_stats = runner.run(max(1, int(round(seconds / tick_s))))
+        cluster.stop(drain_s=1.5)
+    buckets = load_raw_data(out_path)
+    return buckets, stats, run_stats
+
+
+def evaluate_live(buckets, window: int, epochs: int, min_activity: float,
+                  max_metrics: int):
+    """Train on the live corpus's train split; MAE vs both baselines on
+    held-out windows (the same evaluate path the trainer reports)."""
+    from benchmarks.accuracy_dossier import summarize
+    from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
+    from deeprest_tpu.data.featurize import featurize_buckets
+    from deeprest_tpu.models.baselines import baseline_predictions
+    from deeprest_tpu.train import Trainer, prepare_dataset
+
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=64))
+
+    # Keep metrics with real signal (a mostly-idle component's flat-zero
+    # series rewards constant predictors and measures nothing).
+    targets = data.targets()
+    keys = list(data.metric_names)
+    activity = np.abs(np.diff(targets, axis=0)).mean(axis=0)
+    order = np.argsort(-activity)
+    keep = [i for i in order if activity[i] > min_activity][:max_metrics]
+    keep.sort()
+
+    class _Data:
+        traffic = data.traffic
+        metric_names = [keys[i] for i in keep]
+        invocations = data.invocations
+        space = data.space
+
+        def targets(self):
+            return targets[:, keep]
+
+    d = _Data()
+    cfg = Config(
+        model=ModelConfig(feature_dim=data.traffic.shape[1],
+                          num_metrics=len(d.metric_names), hidden_size=128),
+        train=TrainConfig(num_epochs=epochs, batch_size=16,
+                          window_size=window, eval_stride=window,
+                          eval_max_cycles=64, log_every_steps=0, seed=0),
+    )
+    bundle = prepare_dataset(d, cfg.train)
+    trainer = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    baselines = baseline_predictions(d, bundle)
+    state, history = trainer.fit(bundle, baseline_preds=baselines)
+    report = history[-1].report
+    summary, wins, best = summarize(report)
+    return {
+        "report": report, "summary": summary, "wins": wins,
+        "best_by_metric": best, "n_metrics": len(bundle.metric_names),
+        "n_buckets": len(buckets), "window": window, "epochs": epochs,
+        "feature_dim": int(bundle.feature_dim),
+    }
+
+
+def toy_fixture_check():
+    """Featurize the reference's 3-bucket raw_data.pkl; oracle-compare
+    against the reference featurizer when importable."""
+    from deeprest_tpu.data.featurize import featurize_buckets
+    from deeprest_tpu.data.schema import load_raw_data
+
+    out = {"fixture": REF_PICKLE}
+    if not os.path.exists(REF_PICKLE):
+        out["status"] = "fixture not present on this host"
+        return out
+    buckets = load_raw_data(REF_PICKLE)
+    data = featurize_buckets(buckets)
+    out.update(
+        buckets=len(buckets),
+        call_paths_observed=int(data.space.num_observed),
+        traffic_shape=list(data.traffic.shape),
+        metric_keys=sorted(data.resources),
+    )
+    # Oracle: the reference's own featurize functions on the same pickle.
+    ref_dir = os.path.dirname(REF_PICKLE)
+    try:
+        import pickle
+
+        sys.path.insert(0, ref_dir)
+        import featurize as ref_feat  # the reference module
+
+        with open(REF_PICKLE, "rb") as f:
+            raw = pickle.load(f)
+        M = {}
+        for bucket in raw:
+            M = ref_feat.construct_feature_space(M, bucket["traces"])
+        ref_traffic = np.stack([
+            np.asarray(ref_feat.extract_feature(M, b["traces"]),
+                       np.float32) for b in raw])
+        ours = data.traffic[:, :ref_traffic.shape[1]]
+        # Column order may differ (dict growth order is replicated, so it
+        # should not) — require exact equality, the strongest claim.
+        out["oracle"] = {
+            "ref_paths": len(M),
+            "traffic_equal": bool(np.array_equal(ours, ref_traffic)),
+        }
+    except Exception as exc:
+        out["oracle"] = {"error": str(exc)[:200]}
+    finally:
+        if ref_dir in sys.path:
+            sys.path.remove(ref_dir)
+    return out
+
+
+def to_markdown(block: dict) -> str:
+    live, toy = block["live_cluster"], block["toy_fixture"]
+    lines = [
+        BEGIN,
+        "## live-cluster corpus (non-simulator triangulation)",
+        "",
+        f"Generated by `benchmarks/live_dossier.py` "
+        f"({block['generated_utc']}): the REAL native microservice app "
+        f"(native/sns) driven by the load generator for "
+        f"{block['run_seconds']:.0f}s at {block['interval_ms']}ms scrape "
+        f"interval — {live['n_buckets']} buckets of cgroup/proc-MEASURED "
+        f"telemetry (not simulator output).  Model and both baselines "
+        f"fit on the same train split; MAE on held-out windows "
+        f"(window={live['window']}, {live['epochs']} epochs, "
+        f"F={live['feature_dim']}, E={live['n_metrics']}).",
+        "",
+        f"DeepRest has the best median MAE on **{live['wins']['deepr']} "
+        f"of {live['n_metrics']} metrics** (RESRC {live['wins']['resrc']}, "
+        f"COMP {live['wins']['comp']}).",
+        "",
+        "| method | median | p95 | p99 | max | (mean over metrics) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for method in ("deepr", "resrc", "comp"):
+        s = live["summary"][method]
+        lines.append(f"| {method.upper()} | {s['median']:.4f} | "
+                     f"{s['p95']:.4f} | {s['p99']:.4f} | {s['max']:.4f} | |")
+    lines += [
+        "",
+        "**Reference toy-fixture check**: " + (
+            f"the reference's 3-bucket `raw_data.pkl` featurizes to "
+            f"{toy.get('traffic_shape')} with "
+            f"{toy.get('call_paths_observed')} call paths; oracle "
+            f"comparison vs the reference featurizer: "
+            f"`{toy.get('oracle')}`."
+            if toy.get("status") is None else toy["status"]),
+        END,
+    ]
+    return "\n".join(lines)
+
+
+def splice_into_accuracy_md(md: str, path: str) -> None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = "# ACCURACY — flagship-scale MAE dossier\n"
+    if BEGIN in text and END in text:
+        pre = text[:text.index(BEGIN)]
+        post = text[text.index(END) + len(END):]
+        text = pre + md + post
+    else:
+        text = text.rstrip() + "\n\n" + md + "\n"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=300.0,
+                    help="load-generation duration")
+    ap.add_argument("--interval-ms", type=int, default=500,
+                    help="collector scrape interval")
+    ap.add_argument("--window", type=int, default=30)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--min-activity", type=float, default=1e-4)
+    ap.add_argument("--max-metrics", type=int, default=40)
+    ap.add_argument("--corpus", default="/tmp/live_dossier_raw.jsonl")
+    ap.add_argument("--reuse-corpus", action="store_true",
+                    help="skip collection if --corpus already exists")
+    ap.add_argument("--out-json", default=os.path.join(
+        REPO, "benchmarks", "live_dossier.json"))
+    ap.add_argument("--accuracy-md", default=os.path.join(REPO, "ACCURACY.md"))
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")   # host-side experiment
+
+    t0 = time.time()
+    if args.reuse_corpus and os.path.exists(args.corpus):
+        from deeprest_tpu.data.schema import load_raw_data
+
+        buckets = load_raw_data(args.corpus)
+        print(f"reusing corpus: {len(buckets)} buckets")
+    else:
+        buckets, stats, run_stats = collect_live_corpus(
+            args.corpus, args.seconds, args.interval_ms)
+        print(f"collected {len(buckets)} buckets in {time.time()-t0:.0f}s; "
+              f"requests={sum(v for k, v in run_stats.items() if k not in ('error', 'peak_users'))}",
+              flush=True)
+    need = 2 * args.window + 8
+    if len(buckets) < need:
+        raise SystemExit(f"corpus too short: {len(buckets)} buckets < {need} "
+                         f"(raise --seconds or lower --window)")
+
+    live = evaluate_live(buckets, args.window, args.epochs,
+                         args.min_activity, args.max_metrics)
+    print(f"live-cluster: deepr wins {live['wins']['deepr']}"
+          f"/{live['n_metrics']}", flush=True)
+    toy = toy_fixture_check()
+    print(f"toy fixture: {toy.get('oracle', toy.get('status'))}", flush=True)
+
+    block = {
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "run_seconds": args.seconds,
+        "interval_ms": args.interval_ms,
+        "live_cluster": live,
+        "toy_fixture": toy,
+    }
+    with open(args.out_json, "w", encoding="utf-8") as f:
+        json.dump(block, f, indent=2)
+    splice_into_accuracy_md(to_markdown(block), args.accuracy_md)
+    print(f"wrote {args.out_json} and spliced {args.accuracy_md}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,9 @@
 #   3. bench.py          — the headline (writes benchmarks/last_good_tpu.json)
 #   4. accuracy_dossier  — month-scale train + ACCURACY.md (the one
 #                          artifact no round has banked yet)
-#   5. kernel_tuning     — fused-E80 E_BLK x T_BLK x dot-dtype sweep
-#                          (read the result, then update E_BLK/T_BLK in
+#   5. kernel_tuning     — fused-E80 E_BLK x T_BLK x dot-dtype sweep plus
+#                          the round-5 STASH_GATES x LOOP_ORDER knob A/B
+#                          (read the result, then update the defaults in
 #                          deeprest_tpu/ops/pallas_gru.py if a config wins)
 #   6. sharded step      — pallas-under-GSPMD on the real chip (single chip:
 #                          1x1x1 mesh exercises the jit+shard_map path)
@@ -58,7 +59,7 @@ else
   step accuracy 14400 python benchmarks/accuracy_dossier.py \
     --features benchmarks/data/month_10k_features.npz --epochs 12
 fi
-step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r4.json
+step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r5.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -491,7 +491,8 @@ class StreamingTrainer:
         for _ in range(self.stream.finetune_epochs):
             self.state, train_loss = self.trainer.train_epoch(
                 self.state, bundle, data_rng, staged=staged)
-        eval_loss, _ = self.trainer.evaluate(self.state, bundle)
+        eval_loss, _ = self.trainer.evaluate(self.state, bundle,
+                                             staged=staged)
 
         path = None
         self._pending = 0

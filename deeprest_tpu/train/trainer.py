@@ -107,9 +107,15 @@ class Trainer:
             loss = pinball_loss(preds, yb, quantiles)
             return preds, loss
 
+        def eval_step_indexed(params, x_base, y_base, starts):
+            w = self.config.train.window_size
+            idx = starts[:, None] + jnp.arange(w)[None, :]    # [n, W]
+            return eval_step(params, x_base[idx], y_base[idx])
+
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._train_step_indexed = jax.jit(train_step_indexed, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
+        self._eval_step_indexed = jax.jit(eval_step_indexed)
         self._predict_step = jax.jit(
             lambda params, xb: self.model.apply(
                 {"params": params}, xb, deterministic=True
@@ -249,12 +255,16 @@ class Trainer:
         state: TrainState,
         bundle: DatasetBundle,
         baseline_preds: Mapping[str, np.ndarray] | None = None,
+        staged=None,
     ) -> tuple[float, dict]:
         """Reference-semantics eval: strided windows, de-normalized MAE.
 
         ``baseline_preds`` maps method name → *de-normalized* ``[N_test, W, E]``
         predictions aligned with ``bundle.x_test``; errors for those methods
         are computed on the same windows for a comparable report.
+        ``staged`` (from :meth:`stage_dataset`) gathers the eval windows
+        from the device-resident base series — test window i starts at
+        base row ``split + i`` — shipping only start indices per chunk.
         """
         cfg = self.config.train
         idx = eval_window_indices(len(bundle.x_test), cfg.eval_stride,
@@ -271,9 +281,14 @@ class Trainer:
         preds_chunks, loss_sum = [], 0.0
         for lo in range(0, len(idx), bs):
             sel = idx[lo:lo + bs]
-            xb = feed_replicated(self.mesh, bundle.x_test[sel])
-            yb = feed_replicated(self.mesh, bundle.y_test[sel])
-            p, l = self._eval_step(state.params, xb, yb)
+            if staged is not None:
+                starts = feed_replicated(
+                    self.mesh, (bundle.split + sel).astype(np.int32))
+                p, l = self._eval_step_indexed(state.params, *staged, starts)
+            else:
+                xb = feed_replicated(self.mesh, bundle.x_test[sel])
+                yb = feed_replicated(self.mesh, bundle.y_test[sel])
+                p, l = self._eval_step(state.params, xb, yb)
             preds_chunks.append(np.asarray(gather_to_host(p)))
             loss_sum += float(l) * len(sel)
         preds = np.concatenate(preds_chunks, axis=0)
@@ -330,7 +345,8 @@ class Trainer:
         for epoch in range(total):
             state, train_loss = self.train_epoch(state, bundle, data_rng,
                                                  staged=staged)
-            test_loss, report = self.evaluate(state, bundle, baseline_preds)
+            test_loss, report = self.evaluate(state, bundle, baseline_preds,
+                                              staged=staged)
             result = EpochResult(epoch=epoch, train_loss=train_loss,
                                  test_loss=test_loss, report=report)
             history.append(result)

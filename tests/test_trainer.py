@@ -259,3 +259,19 @@ def test_device_resident_feed_matches_host_feed(bundle):
                                             device_data_max_bytes=8))
     assert Trainer(tiny, bundle.feature_dim,
                    bundle.metric_names).stage_dataset(bundle) is None
+
+
+@pytest.mark.slow
+def test_staged_evaluate_matches_host_evaluate(bundle):
+    """evaluate(staged=...) gathers eval windows from the device-resident
+    base series; loss and report must match the host window-shipping path
+    exactly for f32 models."""
+    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    staged = trainer.stage_dataset(bundle)
+    assert staged is not None           # else both paths below are the same
+    state = trainer.init_state(bundle.x_train, seed=1)
+    loss_h, report_h = trainer.evaluate(state, bundle)
+    loss_d, report_d = trainer.evaluate(state, bundle, staged=staged)
+    assert loss_h == loss_d
+    for metric in report_h:
+        assert report_h[metric]["deepr"] == report_d[metric]["deepr"]
