@@ -51,16 +51,52 @@ class AnomalyDetector:
 
     def check(self, traffic: np.ndarray, observed: np.ndarray) -> list[AnomalyReport]:
         """traffic: [T, F] feature series; observed: [T, E] de-normalized
-        utilization aligned with ``predictor.metric_names``."""
-        preds = self.predictor.predict_series(traffic)      # [T, E, Q]
+        utilization aligned with ``predictor.metric_names``.
+
+        Delta-trained metrics (``predictor.delta_mask``) are checked in
+        INCREMENT space: the observed series is differenced and compared
+        against the model's raw per-bucket increment band — abnormal
+        write RATE is the ransomware signal, and a level comparison would
+        dilute it with rollout drift accumulated over the whole series.
+        """
+        dm = getattr(self.predictor, "delta_mask", None)
+        preds = self.predictor.predict_series(
+            traffic, integrate=False)                       # [T, E, Q]
         med = self.predictor.median_index()
+        observed = np.array(observed, np.float32, copy=True)
         for e, metric in enumerate(self.predictor.metric_names):
+            if dm is not None and dm[e]:
+                # increment space: diff the observation; first bucket has
+                # no predecessor → zero increment (never flags).
+                observed[1:, e] = np.diff(observed[:, e])
+                observed[0, e] = 0.0
+                continue
             resource = metric.rsplit("_", 1)[-1]
             if resource in self.reanchor_resources:
                 preds[:, e, :] += observed[0, e] - preds[0, e, med]
         upper = preds[..., -1]                               # highest quantile
         scale = np.maximum(np.abs(upper), 1e-6)
-        excess = np.maximum(observed - upper * (1 + self.tolerance), 0.0) / scale
+        if dm is not None and dm.any():
+            # A quiet store's predicted increment band sits near zero,
+            # making a MULTIPLICATIVE tolerance meaningless (any scrape
+            # noise reads as huge normalized excess).  Floor the scale of
+            # increment-space metrics at the train split's increment
+            # range — model-anchored (an attacker cannot inflate it), and
+            # "tolerance" then means a fraction of a NORMAL-sized
+            # increment, matching its meaning for level metrics.
+            rng_e = np.asarray(self.predictor.y_stats.range,
+                               np.float32).reshape(-1)
+            # A train-split-idle store has a degenerate (zero) increment
+            # range — fall back to the largest increment range across
+            # delta metrics so a few benign bytes of first-ever activity
+            # don't read as ransomware (the 1e-6 scale would make any
+            # noise an enormous normalized excess).
+            floor = rng_e[dm]
+            fallback = float(np.max(floor)) if np.max(floor) > 0 else 1.0
+            floor = np.where(floor > 0, floor, fallback)
+            scale[:, dm] = np.maximum(scale[:, dm], floor)
+        excess = np.maximum(observed - upper - self.tolerance * scale,
+                            0.0) / scale
 
         reports = []
         for e, metric in enumerate(self.predictor.metric_names):

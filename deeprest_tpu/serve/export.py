@@ -120,10 +120,12 @@ class ExportedPredictor:
 
         return CallPathSpace.from_dict(self.space_dict)
 
-    def predict_series(self, traffic: np.ndarray) -> np.ndarray:
+    def predict_series(self, traffic: np.ndarray,
+                       integrate: bool = True) -> np.ndarray:
         """[T, F] raw traffic → de-normalized [T, E, Q] predictions, same
-        tiling semantics as the in-process Predictor."""
+        tiling/integration semantics as the in-process Predictor."""
         return rolled_prediction(
             self._exported.call, self.x_stats, self.y_stats,
             self.window_size, traffic,
-            delta_mask=self.delta_mask, median_index=self.median_index())
+            delta_mask=self.delta_mask if integrate else None,
+            median_index=self.median_index())

@@ -189,11 +189,16 @@ class Predictor:
 
     # ------------------------------------------------------------------
 
-    def predict_series(self, traffic: np.ndarray) -> np.ndarray:
+    def predict_series(self, traffic: np.ndarray,
+                       integrate: bool = True) -> np.ndarray:
         """[T, F] raw traffic features → de-normalized [T, E, Q] predictions
         (see :func:`rolled_prediction` for the tiling semantics; delta-
-        trained metrics come back integrated to a relative level series)."""
+        trained metrics come back integrated to a relative level series).
+        ``integrate=False`` leaves delta-trained columns as raw per-bucket
+        increments — the sharper domain for anomaly detection (abnormal
+        write RATE, no rollout drift)."""
         return rolled_prediction(
             lambda x: self._apply(self.params, jnp.asarray(x)),
             self.x_stats, self.y_stats, self.window_size, traffic,
-            delta_mask=self.delta_mask, median_index=self.median_index())
+            delta_mask=self.delta_mask if integrate else None,
+            median_index=self.median_index())

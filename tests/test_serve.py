@@ -124,3 +124,36 @@ def test_rolled_prediction_batching_invariant(trained):
                               pred.window_size, traffic, max_batch=2)
     # not bit-equal: XLA fuses differently per compiled batch shape
     np.testing.assert_allclose(small, big, rtol=1e-3, atol=1e-4)
+
+
+def test_anomaly_ransomware_flags_usage_increments(trained):
+    """Ransomware-style IO (traffic-independent write volume) must flag
+    the victim store's usage — checked in INCREMENT space for
+    delta-trained metrics, where abnormal write rate is undiluted by
+    rollout drift — and stay quiet on the same store in a clean corpus."""
+    corpus, space, data, bundle, trainer, state, ckpt_dir = trained
+    pred = Predictor.from_checkpoint(ckpt_dir, CFG)
+    assert pred.delta_mask is not None and pred.delta_mask.any()
+    detector = AnomalyDetector(pred, tolerance=0.10, min_run=5)
+
+    victims = [m for m in bundle.metric_names if m.endswith("_usage")]
+    assert victims
+    victim_comp = victims[0].rsplit("_", 1)[0]
+    scn = crypto_scenario(21)
+    scn.calls_per_user = 0.3
+    bad = simulate_corpus(scn, 80, anomalies=[
+        Anomaly(kind="ransomware", component=victim_comp, start=30, end=60)])
+    bad_data = featurize_buckets(bad, space=space)
+    observed = np.stack([bad_data.resources[m] for m in bundle.metric_names], -1)
+    reports = {r.metric: r for r in detector.check(bad_data.traffic, observed)}
+    assert reports[f"{victim_comp}_usage"].flagged
+
+    clean_scn = normal_scenario(22)
+    clean_scn.calls_per_user = 0.3
+    clean = simulate_corpus(clean_scn, 80)
+    clean_data = featurize_buckets(clean, space=space)
+    clean_obs = np.stack([clean_data.resources[m] for m in bundle.metric_names], -1)
+    clean_reports = {r.metric: r
+                     for r in detector.check(clean_data.traffic, clean_obs)}
+    assert clean_reports[f"{victim_comp}_usage"].score \
+        < reports[f"{victim_comp}_usage"].score
