@@ -329,21 +329,28 @@ def _bwd_kernel(proj_ref, hprev_ref, *refs, dot_dtype, stash_gates,
         da_r = dtanh * hn * r * (1.0 - r)
         da_z = dz * z * (1.0 - z)
         dhn = dtanh * r
-        dgates_h = jnp.concatenate([da_r, da_z, dhn], axis=-1)   # [B,3H]
-        dproj_ref[i, tt] = jnp.concatenate(
-            [da_r, da_z, dtanh], axis=-1
-        ).astype(dproj_ref.dtype)
+        # Gate-sliced stores instead of jnp.concatenate: each concat is a
+        # full [B,3H] VPU copy per expert-step; the gate pieces land
+        # directly in their 128-aligned lane slices of the output block
+        # and the dgates stash (dot dtype — the SAME quantization the
+        # old per-step dW dot applied).
+        hh = da_r.shape[-1]
+        dproj_ref[i, tt, :, 0:hh] = da_r.astype(dproj_ref.dtype)
+        dproj_ref[i, tt, :, hh:2 * hh] = da_z.astype(dproj_ref.dtype)
+        dproj_ref[i, tt, :, 2 * hh:3 * hh] = dtanh.astype(dproj_ref.dtype)
+        dg_scr[i, tt, :, 0:hh] = da_r.astype(dg_scr.dtype)
+        dg_scr[i, tt, :, hh:2 * hh] = da_z.astype(dg_scr.dtype)
+        dg_scr[i, tt, :, 2 * hh:3 * hh] = dhn.astype(dg_scr.dtype)
 
-        # dh_prev = dh·z + dgates_h @ W_hhᵀ   (contract the 3H axis)
+        # dh_prev = dh·z + dgates_h @ W_hhᵀ (contract the 3H axis); the
+        # dgates operand reads back from the stash in the dot dtype.
         dhs[i] = dh_total * z + jax.lax.dot_general(
-            dgates_h.astype(dot_dtype), ws[i], (((1,), (1,)), ((), ())),
+            dg_scr[i, tt], ws[i], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # Stash dgates for the block-batched dW dot below.  In the
-        # bf16 path this is the SAME quantization the old per-step dW
-        # dot applied (dgates were cast to the dot dtype anyway).
-        dg_scr[i, tt] = dgates_h.astype(dg_scr.dtype)
-        dbs[i] = dbs[i] + jnp.sum(dgates_h, axis=0)
+        dbs[i] = dbs[i] + jnp.concatenate(
+            [jnp.sum(da_r, axis=0), jnp.sum(da_z, axis=0),
+             jnp.sum(dhn, axis=0)])
 
     if loop_order == "time_inner":
         for i in range(n_e):               # experts OUTER: W_hh stays hot
