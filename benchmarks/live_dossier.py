@@ -219,16 +219,24 @@ def to_markdown(block: dict) -> str:
     return "\n".join(lines)
 
 
+def extract_live_block(text: str) -> str | None:
+    """The marker-delimited live-cluster section of an ACCURACY.md body
+    (None when absent) — the one owner of the marker-slicing logic, used
+    by the splice below and by accuracy_dossier.py's rewrite-preserve."""
+    if BEGIN in text and END in text:
+        return text[text.index(BEGIN):text.index(END) + len(END)]
+    return None
+
+
 def splice_into_accuracy_md(md: str, path: str) -> None:
     try:
         with open(path, encoding="utf-8") as f:
             text = f.read()
     except OSError:
         text = "# ACCURACY — flagship-scale MAE dossier\n"
-    if BEGIN in text and END in text:
-        pre = text[:text.index(BEGIN)]
-        post = text[text.index(END) + len(END):]
-        text = pre + md + post
+    old = extract_live_block(text)
+    if old is not None:
+        text = text.replace(old, md)
     else:
         text = text.rstrip() + "\n\n" + md + "\n"
     with open(path, "w", encoding="utf-8") as f:

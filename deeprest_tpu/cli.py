@@ -119,6 +119,31 @@ def cmd_featurize(args) -> int:
     return 0
 
 
+def _parse_metric_map(specs, metric_rule_cls):
+    """``PROM_METRIC:RESOURCE[:MODE]`` specs → {metric: MetricRule}.
+
+    None → None (use the cadvisor-style defaults).  An explicitly-empty
+    list is honored (traces only, suppress all metrics) rather than
+    silently falling back to the default.  Raises ValueError on bad
+    entries — a typo'd mode must not silently average a cumulative
+    counter into monotonically exploding values.
+    """
+    if specs is None:
+        return None
+    resource_map = {}
+    for spec in specs:
+        parts = spec.split(":")
+        if len(parts) not in (2, 3) or not all(parts):
+            raise ValueError(f"bad --metric-map entry {spec!r} "
+                             "(want prom_metric:resource[:gauge|counter])")
+        mode = parts[2] if len(parts) == 3 else "gauge"
+        if mode not in ("gauge", "counter"):
+            raise ValueError(f"bad --metric-map mode {mode!r} in {spec!r} "
+                             "(must be 'gauge' or 'counter')")
+        resource_map[parts[0]] = metric_rule_cls(parts[1], mode)
+    return resource_map
+
+
 def cmd_ingest(args) -> int:
     """Jaeger/OTLP trace dumps + Prometheus range dumps → raw JSONL.
 
@@ -131,26 +156,11 @@ def cmd_ingest(args) -> int:
     from deeprest_tpu.data.ingest import MetricRule, ingest_files, ingest_live
     from deeprest_tpu.data.schema import save_raw_data_jsonl
 
-    resource_map = None
-    if args.metric_map is not None:
-        # An explicitly-empty map is honored (ingest traces only, suppress
-        # all metrics) rather than silently falling back to the default.
-        resource_map = {}
-        for spec in args.metric_map:
-            parts = spec.split(":")
-            if len(parts) not in (2, 3) or not all(parts):
-                print(f"bad --metric-map entry {spec!r} "
-                      "(want prom_metric:resource[:gauge|counter])")
-                return 2
-            prom_name, resource = parts[0], parts[1]
-            mode = parts[2] if len(parts) == 3 else "gauge"
-            if mode not in ("gauge", "counter"):
-                # A typo'd mode must not silently average a cumulative
-                # counter into monotonically exploding values.
-                print(f"bad --metric-map mode {mode!r} in {spec!r} "
-                      "(must be 'gauge' or 'counter')")
-                return 2
-            resource_map[prom_name] = MetricRule(resource, mode)
+    try:
+        resource_map = _parse_metric_map(args.metric_map, MetricRule)
+    except ValueError as exc:
+        print(exc)
+        return 2
     live = bool(args.jaeger_url or args.prom_url)
     if live and (args.traces or args.prom):
         print("ingest: --traces/--prom dumps and --jaeger-url/--prom-url "
@@ -383,11 +393,16 @@ def cmd_stream(args) -> int:
                                        hash_seed=args.hash_seed),
     )
     if live:
-        from deeprest_tpu.data.ingest import LiveEndpointTailer
+        from deeprest_tpu.data.ingest import LiveEndpointTailer, MetricRule
 
+        try:
+            rmap = _parse_metric_map(args.metric_map, MetricRule)
+        except ValueError as exc:
+            print(exc)
+            return 2
         tailer = LiveEndpointTailer(
             jaeger_url=args.jaeger_url, prom_url=args.prom_url,
-            bucket_s=args.bucket_seconds)
+            bucket_s=args.bucket_seconds, resource_map=rmap)
     else:
         tailer = BucketTailer(args.raw)
     for r in st.run(tailer,
@@ -700,6 +715,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket-seconds", type=float, default=5.0,
                    help="live-source discretization window (= scrape "
                         "interval)")
+    p.add_argument("--metric-map", nargs="*", default=None,
+                   metavar="PROM_METRIC:RESOURCE[:MODE]",
+                   help="live-source metric map override "
+                        "(default: cadvisor names; mode: gauge|counter)")
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--capacity", type=int, default=512,
                    help="hash-feature width (static model input dim)")
