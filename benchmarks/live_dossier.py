@@ -223,7 +223,7 @@ def extract_live_block(text: str) -> str | None:
     """The marker-delimited live-cluster section of an ACCURACY.md body
     (None when absent) — the one owner of the marker-slicing logic, used
     by the splice below and by accuracy_dossier.py's rewrite-preserve."""
-    if BEGIN in text and END in text:
+    if BEGIN in text and END in text and text.index(BEGIN) < text.index(END):
         return text[text.index(BEGIN):text.index(END) + len(END)]
     return None
 

@@ -369,6 +369,11 @@ def cmd_stream(args) -> int:
         print("stream: need exactly one source — either --raw JSONL or "
               "live --jaeger-url/--prom-url endpoints")
         return 2
+    if args.metric_map is not None and not live:
+        # Silently ignoring it would hide a typo'd pipeline config.
+        print("stream: --metric-map only applies to the live "
+              "Jaeger/Prometheus source, not --raw JSONL")
+        return 2
 
     cfg = Config(
         model=ModelConfig(feature_dim=args.capacity,

@@ -89,11 +89,15 @@ class TrainConfig:
     # Empty tuple disables the delta formulation entirely.
     delta_resources: tuple[str, ...] = LEVEL_RESOURCES
     # Device-resident input pipeline: "auto" stages the normalized BASE
-    # series in HBM (bf16 for bf16 models) when it fits the byte budget,
-    # and each train step gathers its windows by start index — per-step
-    # host→device traffic becomes [B] int32 instead of the [B,W,F] window
-    # tensor (windows overlap W−1 of W rows; materialized shipping
-    # re-sends every row W times).  "off" always streams from host.
+    # series in HBM (bf16 for bf16 models) on ACCELERATOR backends when it
+    # fits the byte budget, and each train step gathers its windows by
+    # start index — per-step host→device traffic becomes [B] int32
+    # instead of the [B,W,F] window tensor (windows overlap W−1 of W
+    # rows; materialized shipping re-sends every row W times).  On the
+    # CPU backend "auto" does NOT stage: the transfer it avoids is a
+    # memcpy, and XLA's CPU gather lowers to scalar loops (~3× slower
+    # than host streaming at month scale).  "always" forces staging
+    # (tests, virtual meshes); "off" always streams from host.
     device_data: str = "auto"
     device_data_max_bytes: int = 4 << 30
 

@@ -165,13 +165,19 @@ class Trainer:
         transfer (885 MB for a month at F=10240).
         """
         cfg = self.config.train
-        if cfg.device_data not in ("auto", "off"):
+        if cfg.device_data not in ("auto", "always", "off"):
             raise ValueError(
                 f"TrainConfig.device_data={cfg.device_data!r}: must be "
-                f"'auto' or 'off' (an unknown value silently skipping the "
-                f"byte budget could OOM the chip)")
+                f"'auto', 'always', or 'off' (an unknown value silently "
+                f"skipping the byte budget could OOM the chip)")
         if (cfg.device_data == "off" or bundle.x_base is None
                 or bundle.y_base is None):
+            return None
+        if cfg.device_data == "auto" and jax.default_backend() == "cpu":
+            # Staging buys nothing on CPU (the "transfer" is a memcpy) and
+            # XLA's CPU gather lowers to scalar loops — the staged feed
+            # measured ~3× SLOWER than host streaming on the month-scale
+            # CPU dossier.  "always" forces it (tests, virtual meshes).
             return None
         x = np.asarray(bundle.x_base)
         bf16 = jnp.dtype(self.model_config.compute_dtype) == jnp.bfloat16

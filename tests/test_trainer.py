@@ -236,7 +236,10 @@ def test_device_resident_feed_matches_host_feed(bundle):
     the shuffled selection is the same rng stream."""
     import dataclasses
 
-    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    always = Config(model=SMALL.model,
+                    train=dataclasses.replace(SMALL.train,
+                                              device_data="always"))
+    trainer = Trainer(always, bundle.feature_dim, bundle.metric_names)
     staged = trainer.stage_dataset(bundle)
     assert staged is not None           # base series captured by prepare_dataset
 
@@ -249,6 +252,16 @@ def test_device_resident_feed_matches_host_feed(bundle):
     for a, b in zip(jax.tree.leaves(s_host.params), jax.tree.leaves(s_dev.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    # "auto" on the CPU backend skips staging (XLA CPU gather is slow) —
+    # pin the backend so the assertion holds on accelerator hosts too
+    from deeprest_tpu.train import trainer as trainer_mod
+    orig_backend = trainer_mod.jax.default_backend
+    trainer_mod.jax.default_backend = lambda: "cpu"
+    try:
+        assert Trainer(SMALL, bundle.feature_dim,
+                       bundle.metric_names).stage_dataset(bundle) is None
+    finally:
+        trainer_mod.jax.default_backend = orig_backend
     # device_data="off" (and pre-base bundles) fall back to host streaming
     off = Config(model=SMALL.model,
                  train=dataclasses.replace(SMALL.train, device_data="off"))
@@ -257,8 +270,16 @@ def test_device_resident_feed_matches_host_feed(bundle):
     tiny = Config(model=SMALL.model,
                   train=dataclasses.replace(SMALL.train,
                                             device_data_max_bytes=8))
-    assert Trainer(tiny, bundle.feature_dim,
-                   bundle.metric_names).stage_dataset(bundle) is None
+    tiny_trainer = Trainer(tiny, bundle.feature_dim, bundle.metric_names)
+    # the budget gate only engages on accelerator backends ("auto" skips
+    # CPU before it) — pretend we're on one to exercise it
+    from deeprest_tpu.train import trainer as trainer_mod
+    orig = trainer_mod.jax.default_backend
+    trainer_mod.jax.default_backend = lambda: "tpu"
+    try:
+        assert tiny_trainer.stage_dataset(bundle) is None
+    finally:
+        trainer_mod.jax.default_backend = orig
 
 
 @pytest.mark.slow
@@ -266,7 +287,12 @@ def test_staged_evaluate_matches_host_evaluate(bundle):
     """evaluate(staged=...) gathers eval windows from the device-resident
     base series; loss and report must match the host window-shipping path
     exactly for f32 models."""
-    trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names)
+    import dataclasses
+
+    always = Config(model=SMALL.model,
+                    train=dataclasses.replace(SMALL.train,
+                                              device_data="always"))
+    trainer = Trainer(always, bundle.feature_dim, bundle.metric_names)
     staged = trainer.stage_dataset(bundle)
     assert staged is not None           # else both paths below are the same
     state = trainer.init_state(bundle.x_train, seed=1)
