@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Closed-loop load benchmark for the HTTP prediction service.
+
+Measures what the micro-batching engine (serve/batcher.py) buys at the
+REQUEST level — the serving twin of bench.py's training headline: N
+concurrent clients hammer `/v1/predict` over real HTTP with MIXED series
+lengths (so window counts are ragged and the shape ladder is exercised),
+and the run reports throughput plus p50/p95/p99 latency for the batched
+engine vs the per-request baseline (batcher disabled; the shape ladder
+stays on in both modes, so the comparison isolates coalescing, not
+compile avoidance).
+
+The model is a random-init Predictor at a serving-realistic small shape —
+load benching needs the compute graph, not trained weights, and training
+inside a bench would dwarf the measurement.  Closed loop: each client
+issues its next request as soon as the previous one returns, so offered
+load scales with measured capacity rather than overrunning it.
+
+Emits ONE schema-versioned JSON document (benchmarks/serve_bench.json):
+
+    {"schema_version": 1, "metric": "serve_predict_rps", "results": [...],
+     "headline": {...}, "new_compiles_after_warmup": 0, ...}
+
+Schema note (learned from bench.py's round-5 key repurposing): fields are
+never silently redefined — meaning changes bump schema_version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCHEMA_VERSION = 1
+
+# Serving-realistic small shape: big enough that the device batch is real
+# work, small enough that the bench is CPU-friendly.
+F, E, H, W, Q = 32, 8, 128, 24, 3
+# Mixed series lengths -> 1..3 windows per request incl. ragged tails
+# (right-aligned last window): the online capacity-estimation request is
+# "predict for the most recent window(s)".  Solo, every request pads to
+# the bottom rung (8 windows); coalesced, concurrent requests share that
+# padding budget — which is exactly the wasted-MXU-rows failure mode the
+# batcher exists to fix, reproduced at CPU scale.
+SERIES_LENGTHS = (24, 24, 24, 31, 36, 47)
+LADDER = (8, 16, 32, 64)
+
+
+def build_predictor():
+    import numpy as np
+
+    import jax
+
+    from deeprest_tpu.config import ModelConfig
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.models.qrnn import QuantileGRU
+    from deeprest_tpu.serve import Predictor
+
+    mc = ModelConfig(feature_dim=F, num_metrics=E, hidden_size=H,
+                     dropout_rate=0.0)
+    model = QuantileGRU(config=mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, W, F), np.float32),
+                        deterministic=True)["params"]
+    x_stats = MinMaxStats(min=np.float32(0.0), max=np.float32(1.0))
+    y_stats = MinMaxStats(min=np.zeros((E,), np.float32),
+                          max=np.ones((E,), np.float32))
+    names = [f"comp{i // 2}_res{i % 2}" for i in range(E)]
+    return Predictor(params, mc, x_stats, y_stats, names, W, ladder=LADDER)
+
+
+def warm_ladder(pred) -> None:
+    """Compile every rung up front: the measurement must see zero new
+    compiles (the acceptance bar for the shape-bucketed jit cache)."""
+    import numpy as np
+
+    for rung in pred.ladder.ladder:
+        pred.ladder(np.zeros((rung, W, F), np.float32))
+
+
+class _Client(threading.Thread):
+    """One closed-loop client: request, wait, repeat until the deadline."""
+
+    def __init__(self, addr, payloads, deadline, barrier):
+        super().__init__(daemon=True)
+        self.addr = addr
+        self.payloads = payloads
+        self.deadline = deadline
+        self.barrier = barrier
+        self.latencies: list[float] = []
+        self.errors = 0
+
+    def run(self):
+        conn = http.client.HTTPConnection(*self.addr, timeout=60)
+        i = 0
+        self.barrier.wait()
+        while time.perf_counter() < self.deadline:
+            body = self.payloads[i % len(self.payloads)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/v1/predict", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    self.errors += 1
+                    continue
+            except Exception:
+                self.errors += 1
+                conn.close()
+                conn = http.client.HTTPConnection(*self.addr, timeout=60)
+                continue
+            self.latencies.append(time.perf_counter() - t0)
+        conn.close()
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    k = min(len(sorted_vals) - 1, int(round(p / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+def run_cell(addr, payloads, concurrency, duration_s, warmup_s) -> dict:
+    """One (mode, concurrency) measurement cell against a live server."""
+    start = time.perf_counter()
+    deadline = start + warmup_s + duration_s
+    barrier = threading.Barrier(concurrency)
+    clients = [_Client(addr, payloads[i::len(payloads)] or payloads,
+                       deadline, barrier)
+               for i in range(concurrency)]
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join()
+    cut = warmup_s  # drop each client's warmup-phase latencies by time share
+    lats: list[float] = []
+    total = 0
+    for c in clients:
+        total += len(c.latencies)
+        # keep only steady-state samples: requests completed after warmup
+        acc = 0.0
+        for lat in c.latencies:
+            acc += lat
+            if acc >= cut:
+                lats.append(lat)
+    lats.sort()
+    measured = len(lats)
+    errors = sum(c.errors for c in clients)
+    return {
+        "concurrency": concurrency,
+        "requests": measured,
+        "errors": errors,
+        "rps": round(measured / duration_s, 2),
+        "p50_ms": round(1e3 * _percentile(lats, 50), 3) if lats else None,
+        "p95_ms": round(1e3 * _percentile(lats, 95), 3) if lats else None,
+        "p99_ms": round(1e3 * _percentile(lats, 99), 3) if lats else None,
+    }
+
+
+def _git_sha():
+    try:
+        out = subprocess.run(["git", "describe", "--always", "--dirty"],
+                             capture_output=True, text=True, cwd=REPO,
+                             timeout=10)
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="steady-state seconds per (mode, concurrency) cell")
+    ap.add_argument("--warmup", type=float, default=1.0,
+                    help="per-cell warmup seconds (excluded from stats)")
+    ap.add_argument("--concurrency", default="1,4,16,64",
+                    help="comma-separated closed-loop client counts")
+    ap.add_argument("--linger-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--out", default=os.path.join(REPO, "benchmarks",
+                                                  "serve_bench.json"))
+    args = ap.parse_args()
+    concurrencies = [int(c) for c in args.concurrency.split(",")]
+
+    import numpy as np
+
+    import jax
+
+    # The axon site hook re-registers the TPU platform; serving load tests
+    # target the CPU tier (the acceptance harness) unless told otherwise.
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from deeprest_tpu.serve import (
+        BatcherConfig, PredictionServer, PredictionService,
+    )
+
+    pred = build_predictor()
+    warm_ladder(pred)
+    rng = np.random.default_rng(0)
+    payloads = [json.dumps(
+        {"traffic": rng.random((t, F)).astype(np.float32).tolist()}
+    ).encode() for t in SERIES_LENGTHS]
+
+    compiles_after_warmup = pred.ladder.stats()["rung_compiles"]
+    jit_before = pred.jit_cache_size()
+
+    modes = {
+        "batched": BatcherConfig(max_batch=args.max_batch,
+                                 max_linger_s=args.linger_ms / 1e3),
+        "per_request": None,
+    }
+    results = []
+    for mode, batching in modes.items():
+        service = PredictionService(pred, None, backend=f"bench:{mode}",
+                                    batching=batching)
+        server = PredictionServer(service, port=0).start()
+        try:
+            for conc in concurrencies:
+                cell = run_cell(server.address, payloads, conc,
+                                args.duration, args.warmup)
+                cell["mode"] = mode
+                if service.batcher is not None:
+                    s = service.batcher.stats()
+                    cell["batcher"] = {
+                        k: s[k] for k in
+                        ("batches", "windows", "coalesced_batches",
+                         "flush_full", "flush_linger", "flush_pipeline",
+                         "max_batch_windows")
+                    }
+                results.append(cell)
+                print(json.dumps(cell), file=sys.stderr)
+        finally:
+            server.stop()
+
+    new_compiles = pred.ladder.stats()["rung_compiles"] - compiles_after_warmup
+    jit_after = pred.jit_cache_size()
+
+    def _cell(mode, conc):
+        for r in results:
+            if r["mode"] == mode and r["concurrency"] == conc:
+                return r
+        return None
+
+    headline_conc = 16 if 16 in concurrencies else concurrencies[-1]
+    b, p = _cell("batched", headline_conc), _cell("per_request", headline_conc)
+    headline = None
+    if b and p and p["rps"]:
+        headline = {
+            "concurrency": headline_conc,
+            "batched_rps": b["rps"],
+            "per_request_rps": p["rps"],
+            "throughput_speedup": round(b["rps"] / p["rps"], 2),
+            "batched_p99_ms": b["p99_ms"],
+            "per_request_p50_ms": p["p50_ms"],
+            # acceptance: batched p99 <= 2x per-request p50 at same load
+            "latency_ok": (b["p99_ms"] is not None and p["p50_ms"] is not None
+                           and b["p99_ms"] <= 2 * p["p50_ms"]),
+        }
+
+    doc = {
+        "schema_version": SCHEMA_VERSION,
+        "metric": "serve_predict_rps",
+        "platform": jax.devices()[0].platform,
+        "model": {"F": F, "E": E, "H": H, "W": W, "Q": Q,
+                  "weights": "random-init (load bench measures the serving "
+                             "path, not accuracy)"},
+        "workload": {
+            "closed_loop": True,
+            "series_lengths": list(SERIES_LENGTHS),
+            "windows_per_request": [
+                len(range(0, t - W + 1, W)) + (0 if (t - W) % W == 0 else 1)
+                for t in SERIES_LENGTHS],
+            "duration_s": args.duration,
+            "warmup_s": args.warmup,
+        },
+        "batcher": {"max_batch": args.max_batch,
+                    "max_linger_ms": args.linger_ms,
+                    "ladder": list(LADDER)},
+        "results": results,
+        "headline": headline,
+        # Mixed ragged series lengths, two modes, all concurrencies: the
+        # shape ladder must have absorbed every shape it saw post-warmup.
+        "new_compiles_after_warmup": new_compiles,
+        "jit_cache_size": {"before": jit_before, "after": jit_after},
+        "recorded_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"out": args.out, "headline": headline,
+                      "new_compiles_after_warmup": new_compiles}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

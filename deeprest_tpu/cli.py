@@ -440,13 +440,30 @@ def cmd_export(args) -> int:
 
 def cmd_serve(args) -> int:
     """Serve predict / what-if / anomaly over HTTP from a checkpoint or an
-    exported artifact (serve/server.py)."""
+    exported artifact (serve/server.py), with cross-request micro-batching
+    on by default (serve/batcher.py; disable with --no-batcher)."""
+    from deeprest_tpu.serve.batcher import BatcherConfig
     from deeprest_tpu.serve.server import (
         CheckpointReloader, PredictionServer, PredictionService,
     )
 
     if bool(args.ckpt_dir) == bool(args.artifact):
         sys.exit("error: provide exactly one of --ckpt-dir or --artifact")
+    try:
+        ladder = tuple(int(r) for r in args.batch_ladder.split(","))
+    except ValueError:
+        sys.exit(f"error: --batch-ladder {args.batch_ladder!r} is not a "
+                 "comma-separated list of window counts")
+    if not ladder or min(ladder) < 1:
+        sys.exit(f"error: --batch-ladder {args.batch_ladder!r}: rungs must "
+                 "be >= 1")
+    batching = None
+    if not args.no_batcher:
+        if args.batch_max_windows > max(ladder):
+            sys.exit(f"error: --batch-max-windows {args.batch_max_windows} "
+                     f"exceeds the top ladder rung {max(ladder)}")
+        batching = BatcherConfig(max_batch=args.batch_max_windows,
+                                 max_linger_s=args.batch_linger_ms / 1e3)
     if args.watch and not args.ckpt_dir:
         sys.exit("error: --watch requires --ckpt-dir (artifacts are "
                  "immutable; re-export and restart instead)")
@@ -462,15 +479,16 @@ def cmd_serve(args) -> int:
             # served and never reloaded. Worst case of this ordering is one
             # redundant reload of the step we are about to serve anyway.
             reloader = CheckpointReloader(args.ckpt_dir,
-                                          min_interval_s=args.watch)
-        pred = Predictor.from_checkpoint(args.ckpt_dir)
+                                          min_interval_s=args.watch,
+                                          ladder=ladder)
+        pred = Predictor.from_checkpoint(args.ckpt_dir, ladder=ladder)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
             backend += " (watching)"
     else:
         from deeprest_tpu.serve.export import ExportedPredictor
 
-        pred = ExportedPredictor.load(args.artifact)
+        pred = ExportedPredictor.load(args.artifact, ladder=ladder)
         backend = f"artifact:{args.artifact}"
 
     synthesizer = None
@@ -484,12 +502,17 @@ def cmd_serve(args) -> int:
         synthesizer = TraceSynthesizer(space).fit(_load_buckets(args.raw))
 
     service = PredictionService(pred, synthesizer, backend=backend,
-                                reloader=reloader)
+                                reloader=reloader, batching=batching)
     server = PredictionServer(service, host=args.host, port=args.port)
     host, port = server.address
     print(json.dumps({"listening": f"http://{host}:{port}",
                       "backend": backend,
-                      "whatif": synthesizer is not None}), flush=True)
+                      "whatif": synthesizer is not None,
+                      "batching": (None if batching is None else {
+                          "max_batch": batching.max_batch,
+                          "max_linger_ms": batching.max_linger_s * 1e3,
+                          "ladder": list(ladder),
+                      })}), flush=True)
     if args.deadline:
         server.start()
         import time as _time
@@ -780,6 +803,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=2021)
     p.add_argument("--deadline", type=float, default=0,
                    help="stop after this many seconds (0 = run forever)")
+    p.add_argument("--no-batcher", action="store_true",
+                   help="disable cross-request micro-batching (each request "
+                        "dispatches its own device batches; the shape "
+                        "ladder still bounds jit compiles)")
+    p.add_argument("--batch-max-windows", type=int, default=64,
+                   help="flush a coalesced batch at this many windows "
+                        "(should equal the top ladder rung)")
+    p.add_argument("--batch-linger-ms", type=float, default=2.0,
+                   help="max time the first request in a batch waits for "
+                        "co-arrivals before flushing")
+    p.add_argument("--batch-ladder", default="8,16,32,64",
+                   help="comma-separated window-count rungs every device "
+                        "batch is padded up to (bounds the jit cache to "
+                        "one executable per rung)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")

@@ -1,6 +1,10 @@
 """Serving: trained-model prediction, what-if estimation, anomaly detection,
-the portable export artifact, and the HTTP prediction service."""
+the portable export artifact, the cross-request micro-batching engine, and
+the HTTP prediction service."""
 
+from deeprest_tpu.serve.batcher import (
+    BatcherConfig, MicroBatcher, ShapeLadder,
+)
 from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
@@ -10,6 +14,9 @@ from deeprest_tpu.serve.server import (
 )
 
 __all__ = [
+    "BatcherConfig",
+    "MicroBatcher",
+    "ShapeLadder",
     "Predictor",
     "rolled_prediction",
     "WhatIfEstimator",

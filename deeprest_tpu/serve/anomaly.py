@@ -43,7 +43,19 @@ class AnomalyDetector:
         resources whose absolute value depends on history the traffic can't
         see (cumulative disk usage, resident memory) — their prediction bands
         are shifted to start at the first observed value, the reference
-        demo's re-anchoring trick (web-demo/dataloader.py:143-156)."""
+        demo's re-anchoring trick (web-demo/dataloader.py:143-156).
+
+        Tolerance direction is explicit: the threshold is always
+        ``upper + tolerance * scale`` with ``scale > 0``, i.e. headroom
+        strictly ABOVE the band.  For re-anchored metrics the band can go
+        negative (a small first observation anchors predictions below
+        zero); there ``scale`` is floored at the per-metric train-split
+        level range, so "tolerance" keeps meaning a fraction of a
+        NORMAL-sized level — matching the increment-space floor delta
+        metrics already get — instead of shrinking toward zero (and
+        tightening the threshold) as the band crosses zero.  Behavior
+        change vs the earlier ``|upper|``-only scale: near-zero or
+        negative re-anchored bands now get a wider, stable margin."""
         self.predictor = predictor
         self.tolerance = tolerance
         self.min_run = min_run
@@ -64,6 +76,7 @@ class AnomalyDetector:
             traffic, integrate=False)                       # [T, E, Q]
         med = self.predictor.median_index()
         observed = np.array(observed, np.float32, copy=True)
+        reanchored: list[int] = []
         for e, metric in enumerate(self.predictor.metric_names):
             if dm is not None and dm[e]:
                 # increment space: diff the observation; first bucket has
@@ -74,8 +87,22 @@ class AnomalyDetector:
             resource = metric.rsplit("_", 1)[-1]
             if resource in self.reanchor_resources:
                 preds[:, e, :] += observed[0, e] - preds[0, e, med]
+                reanchored.append(e)
         upper = preds[..., -1]                               # highest quantile
         scale = np.maximum(np.abs(upper), 1e-6)
+        if reanchored:
+            # Re-anchored bands can dip to/below zero, where an |upper|
+            # scale degenerates (any noise reads as huge normalized excess
+            # and the tolerance margin tightens toward nothing).  Floor at
+            # the per-metric train-split level range — model-anchored, so
+            # an attacker cannot inflate it — with the same degenerate-
+            # range fallback the delta branch uses.
+            rng_all = np.asarray(self.predictor.y_stats.range,
+                                 np.float32).reshape(-1)
+            floor = rng_all[reanchored]
+            fallback = float(np.max(floor)) if np.max(floor) > 0 else 1.0
+            floor = np.where(floor > 0, floor, fallback)
+            scale[:, reanchored] = np.maximum(scale[:, reanchored], floor)
         if dm is not None and dm.any():
             # A quiet store's predicted increment band sits near zero,
             # making a MULTIPLICATIVE tolerance meaningless (any scrape

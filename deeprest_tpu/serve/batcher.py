@@ -1,0 +1,378 @@
+"""Cross-request micro-batching: many tiny requests → few device batches.
+
+The serving layer's original shape was one jit call per request: N
+concurrent clients meant N serialized device dispatches of tiny window
+batches, a fresh host→device transfer each, and a recompile whenever a
+series length produced a new ragged last-batch shape in
+``rolled_prediction``.  That is the request-level twin of the small-batch
+MXU under-occupancy PERF.md diagnoses inside the recurrence — and the
+fix is the classic model-server one (Clipper/ClockWork-style adaptive
+batching, PAPERS.md): coalesce concurrent requests into shared batches
+behind a bounded queue.
+
+Two pieces, usable separately:
+
+``ShapeLadder``
+    Pads every batch up the fixed rung ladder (default {8, 16, 32, 64}
+    windows) before it reaches the jit-compiled apply, so the jit cache
+    holds a handful of executables — one per rung — instead of one per
+    ragged shape.  Oversized batches split into max-rung chunks.  Padding
+    rows are zeros and their outputs are dropped (pad-and-mask); the
+    model maps rows independently, so valid rows are unaffected.
+
+``MicroBatcher``
+    A worker thread drains a bounded queue of submitted window batches,
+    concatenates them into one ladder dispatch, and demultiplexes the
+    results back to per-request futures — the wire protocol never sees
+    the coalescing.  Flush policy: a batch goes out when ``max_batch``
+    windows are pending or ``max_linger_s`` has elapsed since the first
+    pending arrived, whichever is first.  Host→device staging is
+    double-buffered: while the device executes batch k, the worker is
+    already assembling/staging batch k+1 (JAX dispatch is asynchronous;
+    only the result readback blocks), so host prep overlaps device
+    execution.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+DEFAULT_LADDER = (8, 16, 32, 64)
+
+
+class BatcherClosed(RuntimeError):
+    """Raised by submit() after close(); callers fall back to the direct
+    shape-laddered path (a hot-reload swaps batchers between requests, and
+    a request that lost that race must not fail)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """Flush-policy and queue knobs for :class:`MicroBatcher`.
+
+    ``max_batch`` is in WINDOWS (the device-batch row unit), not requests:
+    one request's chunk may carry many windows.  It should normally equal
+    the top ladder rung so a full flush compiles nothing new.
+    """
+
+    max_batch: int = 64
+    max_linger_s: float = 0.002
+    max_queue: int = 1024        # pending-window bound (submit backpressure)
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch {self.max_batch} must be >= 1")
+        if self.max_linger_s < 0:
+            raise ValueError(f"max_linger_s {self.max_linger_s} must be >= 0")
+        if self.max_queue < self.max_batch:
+            raise ValueError(f"max_queue {self.max_queue} must be >= "
+                             f"max_batch {self.max_batch}")
+
+
+class ShapeLadder:
+    """Pad-and-mask batches onto a fixed shape ladder in front of a
+    batched apply function ``[n, W, F] -> [n, W, E, Q]``.
+
+    ``dispatch``/``materialize`` are split so a caller (the MicroBatcher's
+    double buffer) can overlap the host-side staging + async device
+    dispatch of one batch with the result readback of another;
+    ``__call__`` is the synchronous composition.
+    """
+
+    def __init__(self, apply_fn, ladder=DEFAULT_LADDER):
+        rungs = tuple(sorted({int(r) for r in ladder}))
+        if not rungs or rungs[0] < 1:
+            raise ValueError(f"bad shape ladder {ladder!r}")
+        self._apply = apply_fn
+        self.ladder = rungs
+        self._lock = threading.Lock()
+        self._compiled: set[int] = set()     # rungs dispatched at least once
+        self._calls = 0
+        self._windows = 0
+        self._padded_windows = 0
+        self._rung_hits = 0
+
+    @property
+    def max_rung(self) -> int:
+        return self.ladder[-1]
+
+    def rung_for(self, n: int) -> int:
+        """Smallest rung >= n (callers chunk to max_rung first)."""
+        for r in self.ladder:
+            if n <= r:
+                return r
+        raise ValueError(f"batch of {n} windows exceeds top rung "
+                         f"{self.max_rung}; chunk before dispatching")
+
+    def dispatch(self, x: np.ndarray) -> list[tuple[object, int]]:
+        """Stage + asynchronously dispatch ``x`` as ladder-padded chunks;
+        returns ``[(device_result, valid_rows), ...]`` for materialize()."""
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        parts: list[tuple[object, int]] = []
+        for lo in range(0, len(x), self.max_rung):
+            chunk = x[lo:lo + self.max_rung]
+            rung = self.rung_for(len(chunk))
+            padded = chunk
+            if rung > len(chunk):
+                padded = np.zeros((rung, *chunk.shape[1:]), np.float32)
+                padded[:len(chunk)] = chunk
+            with self._lock:
+                self._calls += 1
+                self._windows += len(chunk)
+                self._padded_windows += rung - len(chunk)
+                if rung in self._compiled:
+                    self._rung_hits += 1
+                else:
+                    self._compiled.add(rung)
+            parts.append((self._apply(padded), len(chunk)))
+        return parts
+
+    @staticmethod
+    def materialize(parts: list[tuple[object, int]]) -> np.ndarray:
+        """Block on the device results and strip the padding rows."""
+        outs = [np.asarray(y)[:n] for y, n in parts]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        return self.materialize(self.dispatch(x))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "ladder": list(self.ladder),
+                "calls": self._calls,
+                "windows": self._windows,
+                "padded_windows": self._padded_windows,
+                "rung_hits": self._rung_hits,
+                "rung_compiles": len(self._compiled),
+                "compiled_rungs": sorted(self._compiled),
+            }
+
+
+class _Pending:
+    __slots__ = ("x", "future")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.future: Future = Future()
+
+
+def _inflight_ready(inflight) -> bool:
+    """True once every device part of an in-flight dispatch has finished
+    (jax.Array.is_ready; results without the probe count as finished)."""
+    if inflight is None:
+        return True
+    for y, _ in inflight[0]:
+        probe = getattr(y, "is_ready", None)
+        if callable(probe) and not probe():
+            return False
+    return True
+
+
+class MicroBatcher:
+    """Coalesces concurrent window-batch submissions into shared ladder
+    dispatches on a single worker thread (see module docstring)."""
+
+    def __init__(self, ladder: ShapeLadder,
+                 config: BatcherConfig | None = None):
+        self.config = config or BatcherConfig()
+        self._ladder = ladder
+        self._cv = threading.Condition()
+        self._pending: collections.deque[_Pending] = collections.deque()
+        self._pending_windows = 0
+        self._running = True
+        self._stats = {"submitted": 0, "batches": 0, "windows": 0,
+                       "max_batch_windows": 0, "coalesced_batches": 0,
+                       "flush_full": 0, "flush_linger": 0,
+                       "flush_pipeline": 0, "errors": 0}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="microbatcher")
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> Future:
+        """Enqueue a ``[n, W, F]`` normalized window batch; the future
+        resolves to the ``[n, W, E, Q]`` result.  Blocks (backpressure)
+        while ``max_queue`` windows are already pending."""
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim != 3 or len(x) == 0:
+            raise ValueError(f"expected non-empty [n, W, F] windows, "
+                             f"got shape {x.shape}")
+        p = _Pending(x)
+        with self._cv:
+            while (self._running
+                   and self._pending_windows + len(x) > self.config.max_queue
+                   and self._pending_windows > 0):
+                self._cv.wait()
+            if not self._running:
+                raise BatcherClosed("micro-batcher is closed")
+            self._pending.append(p)
+            self._pending_windows += len(x)
+            self._stats["submitted"] += 1
+            self._cv.notify_all()
+        return p.future
+
+    def apply(self, x: np.ndarray) -> np.ndarray:
+        """Synchronous submit — the rolled_prediction-compatible entry."""
+        return self.submit(x).result()
+
+    def stats(self) -> dict:
+        with self._cv:
+            out = dict(self._stats)
+            out["queue_depth_windows"] = self._pending_windows
+            out["queue_depth_requests"] = len(self._pending)
+        out["max_batch"] = self.config.max_batch
+        out["max_linger_ms"] = self.config.max_linger_s * 1e3
+        out["shape_ladder"] = self._ladder.stats()
+        return out
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, drain what is queued, join the worker."""
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    # -- worker side ----------------------------------------------------
+
+    def _collect(self, block: bool, inflight_ready=None) -> list[_Pending]:
+        """Take up to ``max_batch`` windows of pending submissions.
+
+        ``block=True`` (nothing in flight): wait indefinitely for the
+        first submission, then linger up to ``max_linger_s`` for
+        co-arrivals, flushing early once ``max_batch`` windows are
+        pending.  ``block=False`` (a batch is executing on the device):
+        the device busy time IS the coalescing window, so waiting up to
+        ``max_linger_s`` here is free overlap — but the wait breaks the
+        moment ``inflight_ready()`` reports the device done, so a
+        finished batch is never held hostage to the linger clock.
+        """
+        cfg = self.config
+        with self._cv:
+            if block:
+                while self._running and not self._pending:
+                    self._cv.wait()
+            if self._running and cfg.max_linger_s > 0:
+                deadline = time.monotonic() + cfg.max_linger_s
+                while (self._running
+                       and self._pending_windows < cfg.max_batch
+                       and (self._pending or not block)):
+                    if (not block and inflight_ready is not None
+                            and inflight_ready()):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left if block else min(left, 5e-4))
+            group: list[_Pending] = []
+            take = 0
+            while self._pending and take < cfg.max_batch:
+                n = len(self._pending[0].x)
+                if group and take + n > cfg.max_batch:
+                    break
+                group.append(self._pending.popleft())
+                take += n
+            if group:
+                self._pending_windows -= take
+                reason = ("flush_pipeline" if not block
+                          else "flush_full" if take >= cfg.max_batch
+                          else "flush_linger")
+                self._stats[reason] += 1
+                self._stats["batches"] += 1
+                self._stats["windows"] += take
+                self._stats["coalesced_batches"] += len(group) > 1
+                self._stats["max_batch_windows"] = max(
+                    self._stats["max_batch_windows"], take)
+                self._cv.notify_all()      # wake back-pressured submitters
+            return group
+
+    def _dispatch(self, group: list[_Pending]):
+        """Concatenate + stage + async-dispatch one coalesced batch."""
+        sizes = [len(p.x) for p in group]
+        try:
+            x = (group[0].x if len(group) == 1
+                 else np.concatenate([p.x for p in group], axis=0))
+            parts = self._ladder.dispatch(x)
+        except Exception as exc:
+            with self._cv:
+                self._stats["errors"] += 1
+            for p in group:
+                p.future.set_exception(exc)
+            return None
+        return parts, group, sizes
+
+    def _resolve(self, inflight) -> None:
+        parts, group, sizes = inflight
+        try:
+            y = ShapeLadder.materialize(parts)
+        except Exception as exc:
+            with self._cv:
+                self._stats["errors"] += 1
+            for p in group:
+                p.future.set_exception(exc)
+            return
+        lo = 0
+        for p, n in zip(group, sizes):
+            p.future.set_result(y[lo:lo + n])
+            lo += n
+
+    def _run(self) -> None:
+        inflight = None
+        while True:
+            # Double buffer: dispatch batch k+1 BEFORE blocking on batch
+            # k's readback, so host concat/pad/staging overlaps device
+            # execution of the previous batch.
+            group = self._collect(
+                block=inflight is None,
+                inflight_ready=lambda: _inflight_ready(inflight))
+            dispatched = self._dispatch(group) if group else None
+            if inflight is not None:
+                self._resolve(inflight)
+            inflight = dispatched
+            if inflight is None:
+                with self._cv:
+                    if not self._running and not self._pending:
+                        return
+
+
+class BatchedBackendMixin:
+    """Shared by Predictor and ExportedPredictor: the shape-laddered batch
+    entry point plus an optional attached MicroBatcher that ALL
+    predict_series traffic (predict / what-if / anomaly) routes through.
+    """
+
+    def _init_batching(self, apply_fn, ladder=None) -> None:
+        self.ladder = ShapeLadder(apply_fn, ladder or DEFAULT_LADDER)
+        self._batcher: MicroBatcher | None = None
+
+    @property
+    def batcher(self) -> MicroBatcher | None:
+        return self._batcher
+
+    def attach_batcher(self, batcher: MicroBatcher | None) -> None:
+        """Route this backend's window batches through ``batcher`` (None
+        detaches).  The batcher must wrap this backend's ``ladder``."""
+        self._batcher = batcher
+
+    def apply_windows(self, x: np.ndarray) -> np.ndarray:
+        """[n, W, F] normalized windows → [n, W, E, Q] de-padded results.
+
+        The single batch entry point behind ``predict_series``: via the
+        attached MicroBatcher when one is present (cross-request
+        coalescing), else a direct shape-laddered dispatch.  Either way
+        the jit cache sees only ladder-rung shapes.
+        """
+        b = self._batcher
+        if b is not None:
+            try:
+                return b.apply(x)
+            except BatcherClosed:
+                pass      # hot-reload race: fall through to the direct path
+        return self.ladder(x)

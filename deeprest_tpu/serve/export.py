@@ -30,6 +30,7 @@ import numpy as np
 from jax import export as jexport
 
 from deeprest_tpu.data.windows import MinMaxStats
+from deeprest_tpu.serve.batcher import BatchedBackendMixin
 from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
 
 ARTIFACT_BLOB = "model.stablehlo"
@@ -75,16 +76,21 @@ def export_predictor(pred: Predictor, directory: str) -> str:
     return directory
 
 
-class ExportedPredictor:
+class ExportedPredictor(BatchedBackendMixin):
     """Drop-in serving backend loaded from an artifact directory.
 
     Exposes the same serving protocol as :class:`Predictor`
     (``predict_series``, ``metric_names``, ``window_size``, ``quantiles``,
-    ``feature_dim``, ``median_index``, ``space``), so AnomalyDetector,
-    WhatIfEstimator, and the HTTP server work unchanged on either backend.
+    ``feature_dim``, ``median_index``, ``space``, and the batched
+    ``apply_windows`` entry point incl. MicroBatcher attachment), so
+    AnomalyDetector, WhatIfEstimator, and the HTTP server work unchanged
+    on either backend.  The artifact's symbolic batch dimension still
+    compiles one executable per concrete shape it sees — the shape ladder
+    bounds that set to the rungs, exactly as on the in-process path.
     """
 
-    def __init__(self, exported: jexport.Exported, manifest: dict):
+    def __init__(self, exported: jexport.Exported, manifest: dict,
+                 ladder: tuple[int, ...] | None = None):
         if manifest.get("format") != _FORMAT:
             raise ValueError(f"unknown artifact format {manifest.get('format')!r}")
         self._exported = exported
@@ -98,15 +104,17 @@ class ExportedPredictor:
         self.space_dict = manifest.get("space")
         dm = manifest.get("delta_mask")
         self.delta_mask = np.asarray(dm, bool) if dm is not None else None
+        self._init_batching(self._exported.call, ladder=ladder)
 
     @classmethod
-    def load(cls, directory: str) -> "ExportedPredictor":
+    def load(cls, directory: str,
+             ladder: tuple[int, ...] | None = None) -> "ExportedPredictor":
         with open(os.path.join(directory, ARTIFACT_MANIFEST),
                   encoding="utf-8") as f:
             manifest = json.load(f)
         with open(os.path.join(directory, ARTIFACT_BLOB), "rb") as f:
             exported = jexport.deserialize(f.read())
-        return cls(exported, manifest)
+        return cls(exported, manifest, ladder=ladder)
 
     def median_index(self) -> int:
         diffs = [abs(q - 0.5) for q in self.quantiles]
@@ -123,9 +131,10 @@ class ExportedPredictor:
     def predict_series(self, traffic: np.ndarray,
                        integrate: bool = True) -> np.ndarray:
         """[T, F] raw traffic → de-normalized [T, E, Q] predictions, same
-        tiling/integration semantics as the in-process Predictor."""
+        tiling/integration/shape-ladder semantics as the in-process
+        Predictor (windows route through ``apply_windows``)."""
         return rolled_prediction(
-            self._exported.call, self.x_stats, self.y_stats,
+            self.apply_windows, self.x_stats, self.y_stats,
             self.window_size, traffic,
             delta_mask=self.delta_mask if integrate else None,
             median_index=self.median_index())

@@ -16,6 +16,7 @@ import numpy as np
 from deeprest_tpu.config import Config, ModelConfig
 from deeprest_tpu.data.windows import MinMaxStats
 from deeprest_tpu.models.qrnn import QuantileGRU
+from deeprest_tpu.serve.batcher import BatchedBackendMixin
 
 
 def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
@@ -34,6 +35,13 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
     batch of them is ever resident on device).  Shared by the in-process
     Predictor and the exported-artifact loader so both serve identical
     semantics by construction.
+
+    A ragged last batch (series length not a multiple of
+    ``max_batch * window_size``) is NOT a new device shape: both serving
+    backends hand in a shape-laddered ``apply_fn``
+    (serve/batcher.ShapeLadder) that pads every batch up a fixed rung
+    ladder and strips the padding rows, so the jit cache holds one
+    executable per rung instead of one per ragged shape.
 
     ``delta_mask`` marks metrics the model predicts as per-bucket
     increments (train/data.py delta formulation): those columns are
@@ -80,14 +88,15 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
     return out
 
 
-class Predictor:
+class Predictor(BatchedBackendMixin):
     """Quantile predictions for traffic feature series."""
 
     def __init__(self, params, model_config: ModelConfig,
                  x_stats: MinMaxStats, y_stats: MinMaxStats,
                  metric_names: list[str], window_size: int,
                  space_dict: dict | None = None,
-                 delta_mask: np.ndarray | None = None):
+                 delta_mask: np.ndarray | None = None,
+                 ladder: tuple[int, ...] | None = None):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -105,6 +114,19 @@ class Predictor:
         self._apply = jax.jit(
             lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
         )
+        # All serving batches go through the shape ladder (and, when one
+        # is attached, the cross-request MicroBatcher): the jit cache
+        # holds one executable per rung, never one per ragged shape.
+        self._init_batching(
+            lambda x: self._apply(self.params, jnp.asarray(x)),
+            ladder=ladder)
+
+    def jit_cache_size(self) -> int | None:
+        """Compiled-executable count of the serving apply (None when the
+        running jax version has no cache probe) — the test hook behind the
+        'mixed series lengths trigger zero new compiles' guarantee."""
+        probe = getattr(self._apply, "_cache_size", None)
+        return int(probe()) if callable(probe) else None
 
     @property
     def model_config(self) -> ModelConfig:
@@ -131,7 +153,8 @@ class Predictor:
 
     @classmethod
     def from_checkpoint(cls, directory: str, config: Config | None = None,
-                        step: int | None = None) -> "Predictor":
+                        step: int | None = None,
+                        ladder: tuple[int, ...] | None = None) -> "Predictor":
         """Restore params + host stats written by Trainer.save().
 
         With ``config=None`` the architecture comes wholesale from the
@@ -176,6 +199,7 @@ class Predictor:
             window_size=extra["window_size"],
             space_dict=extra.get("space"),
             delta_mask=extra.get("delta_mask"),
+            ladder=ladder,
         )
 
     def space(self):
@@ -196,9 +220,13 @@ class Predictor:
         trained metrics come back integrated to a relative level series).
         ``integrate=False`` leaves delta-trained columns as raw per-bucket
         increments — the sharper domain for anomaly detection (abnormal
-        write RATE, no rollout drift)."""
+        write RATE, no rollout drift).
+
+        Windows route through :meth:`apply_windows` — the shape-laddered
+        batch entry point, coalesced across concurrent requests when a
+        MicroBatcher is attached (serve/batcher.py)."""
         return rolled_prediction(
-            lambda x: self._apply(self.params, jnp.asarray(x)),
+            self.apply_windows,
             self.x_stats, self.y_stats, self.window_size, traffic,
             delta_mask=self.delta_mask if integrate else None,
             median_index=self.median_index())

@@ -18,8 +18,12 @@ Routes (all JSON):
     POST /v1/anomaly          {"traffic", "observed", "tolerance"?, "min_run"?}
 
 Built on the stdlib ThreadingHTTPServer: one small dependency-free binary
-surface, good enough for the sidecar role (the heavy lifting is one jit
-call per request; XLA serializes on the device anyway).
+surface.  Concurrent requests do NOT each pay a device dispatch: the
+service attaches a cross-request MicroBatcher (serve/batcher.py) to the
+backend, so windows from simultaneous /v1/predict, /v1/whatif*, and
+/v1/anomaly calls coalesce into shared shape-laddered device batches and
+demultiplex back per request — the wire protocol is unchanged, and
+``/healthz`` exposes queue depth and ladder hit statistics.
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from deeprest_tpu.serve.anomaly import AnomalyDetector
+from deeprest_tpu.serve.batcher import BatcherConfig, MicroBatcher
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 
 
@@ -53,11 +58,13 @@ class CheckpointReloader:
     consistent within each Predictor.
     """
 
-    def __init__(self, ckpt_dir: str, min_interval_s: float = 2.0):
+    def __init__(self, ckpt_dir: str, min_interval_s: float = 2.0,
+                 ladder: tuple[int, ...] | None = None):
         from deeprest_tpu.train.checkpoint import latest_step
 
         self.ckpt_dir = ckpt_dir
         self.min_interval_s = min_interval_s
+        self.ladder = ladder      # reloaded predictors keep the serving ladder
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
         self._pending = None       # loaded Predictor awaiting pickup
@@ -100,7 +107,8 @@ class CheckpointReloader:
 
         fresh = None
         try:
-            fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step)
+            fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step,
+                                              ladder=self.ladder)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/
             # ValueError); anything else is logged but must never wedge
@@ -138,17 +146,47 @@ class PredictionService:
     ``reloader`` (optional) makes the service follow a live training
     process: before each request it is asked for a fresh backend (or None
     to keep the current one) — see :class:`CheckpointReloader`.
+
+    ``batching`` (optional :class:`~deeprest_tpu.serve.batcher.BatcherConfig`)
+    attaches a cross-request MicroBatcher to the backend: windows from
+    concurrent requests coalesce into shared device batches.  None (the
+    default) keeps the per-request dispatch path — each request still
+    goes through the backend's shape ladder, so the jit cache stays
+    rung-bounded either way.
     """
 
     def __init__(self, predictor, synthesizer=None, backend: str = "",
-                 reloader=None):
+                 reloader=None, batching: BatcherConfig | None = None):
         self.predictor = predictor
         self.backend = backend
         self._synthesizer = synthesizer
         self._reloader = reloader
         self.reloads = 0
+        self.batcher: MicroBatcher | None = None
+        self.batching = None
         self.whatif = (WhatIfEstimator(predictor, synthesizer)
                        if synthesizer is not None else None)
+        if batching is not None:
+            self.enable_batching(batching)
+
+    def enable_batching(self, config: BatcherConfig) -> None:
+        """(Re)build the cross-request MicroBatcher over the current
+        backend's shape ladder and route its traffic through it."""
+        self.batching = config
+        self._rebuild_batcher(self.predictor)
+
+    def _rebuild_batcher(self, predictor) -> None:
+        old, self.batcher = self.batcher, None
+        if old is not None:
+            old.close()
+        if self.batching is not None:
+            self.batcher = MicroBatcher(predictor.ladder, self.batching)
+            predictor.attach_batcher(self.batcher)
+
+    def close(self) -> None:
+        """Release the batcher's worker thread (idempotent)."""
+        self.batching = None
+        self._rebuild_batcher(self.predictor)
 
     def maybe_reload(self) -> None:
         """Swap in a newer backend if the reloader has one (serving a
@@ -160,19 +198,33 @@ class PredictionService:
             return
         self.predictor = fresh
         self.reloads += 1
+        # The fresh backend gets its own batcher; the old one drains and
+        # closes — a request that raced the swap falls back to the direct
+        # laddered path (BatcherClosed is handled in apply_windows).
+        self._rebuild_batcher(fresh)
         if self._synthesizer is not None:
             self.whatif = WhatIfEstimator(fresh, self._synthesizer)
 
     # -- GET ------------------------------------------------------------
 
     def healthz(self) -> dict:
-        return {
+        out = {
             "ok": True,
             "backend": self.backend,
             "num_metrics": len(self.predictor.metric_names),
             "window_size": self.predictor.window_size,
             "reloads": self.reloads,
         }
+        # Queue depth + shape-ladder hit stats ride on the liveness probe
+        # (additive keys: the wire protocol's existing fields are
+        # untouched).  Batching disabled still reports the backend's
+        # ladder so compile behavior is observable either way.
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.stats()
+        elif getattr(self.predictor, "ladder", None) is not None:
+            out["batcher"] = None
+            out["shape_ladder"] = self.predictor.ladder.stats()
+        return out
 
     def meta(self) -> dict:
         return {
@@ -305,11 +357,16 @@ class PredictionServer:
     >>> srv = PredictionServer(service, port=0).start()
     >>> ... http requests against srv.address ...
     >>> srv.stop()
+
+    ``batching`` forwards a :class:`BatcherConfig` to the service (the
+    CLI's knob surface); None leaves the service's own setting alone.
     """
 
     def __init__(self, service: PredictionService, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, batching: BatcherConfig | None = None):
         self.service = service
+        if batching is not None:
+            service.enable_batching(batching)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -352,7 +409,13 @@ class PredictionServer:
                 except Exception as e:  # handler bug: 500, not a dead socket
                     self._reply(500, {"error": f"internal: {e}"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # The stdlib default listen backlog (5) drops SYNs when a
+            # fleet of clients connects at once; the kernel's ~1s
+            # retransmit then shows up as a phantom p99 latency cliff.
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), Handler)
         self._thread: threading.Thread | None = None
 
     @property
@@ -373,3 +436,4 @@ class PredictionServer:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.service.close()       # drain + join the batcher worker

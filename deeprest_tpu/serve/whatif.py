@@ -72,9 +72,22 @@ class WhatIfEstimator:
         web-demo/dataloader.py:143-156).  For delta-trained level metrics
         the factor compares GROWTH over the program (peak minus start) —
         the reference demo's own post-re-anchor semantics; a peak ratio on
-        a relative-from-zero rollout would be meaningless."""
-        base = self.estimate(baseline_traffic, seed=seed)
-        hypo = self.estimate(hypothetical_traffic, seed=seed + 1)
+        a relative-from-zero rollout would be meaningless.
+
+        With a MicroBatcher attached to the predictor the two programs
+        are estimated CONCURRENTLY, so their windows coalesce into shared
+        device batches instead of two sequential dispatch trains."""
+        if getattr(self.predictor, "batcher", None) is not None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                fb = pool.submit(self.estimate, baseline_traffic, seed)
+                fh = pool.submit(self.estimate, hypothetical_traffic,
+                                 seed + 1)
+                base, hypo = fb.result(), fh.result()
+        else:
+            base = self.estimate(baseline_traffic, seed=seed)
+            hypo = self.estimate(hypothetical_traffic, seed=seed + 1)
         factors = {}
         for e, metric in enumerate(self.predictor.metric_names):
             bs, hs = base[metric]["q50"], hypo[metric]["q50"]

@@ -488,3 +488,103 @@ def test_live_tailer_preserves_counter_increments():
         assert len(buckets) == 1
         got.append(buckets[0].metrics[0].value)
     assert got == [10.0] * 7, got
+
+
+def test_live_tailer_zero_fills_silent_ranges():
+    """A successful pull that returns no buckets must not silently skip
+    the time range: the tailer emits explicitly-empty buckets for the
+    grid cells so downstream windowing never treats non-adjacent buckets
+    as adjacent (a counter increase across the gap would otherwise land
+    in one bucket)."""
+    from deeprest_tpu.data.ingest import LiveEndpointTailer, MetricRule
+
+    rmap = {"g": MetricRule("cpu", "gauge")}
+    quiet = [True]
+
+    def fetch(url, timeout_s=0):
+        from urllib.parse import parse_qs, urlparse
+
+        u = urlparse(url)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        lo, hi = float(q["start"]), float(q["end"])
+        vals = [] if quiet[0] else [
+            [t, "1.0"] for t in
+            [lo + BUCKET_S * (i + 0.5) for i in range(int((hi - lo) // BUCKET_S) + 1)]
+            if t <= hi
+        ]
+        return {"status": "success", "data": {"resultType": "matrix",
+                "result": [{"metric": {"__name__": "g", "pod": "a"},
+                            "values": vals}] if vals else []}}
+
+    clock = [T0]
+    tailer = LiveEndpointTailer(prom_url="http://stub", bucket_s=BUCKET_S,
+                                resource_map=rmap, lag_s=0.0,
+                                now=lambda: clock[0], fetch=fetch)
+    clock[0] = T0 + 3 * BUCKET_S          # three cells, all silent
+    buckets = tailer.poll()
+    assert len(buckets) == 3              # zero-filled, not skipped
+    assert all(not b.metrics and not b.traces for b in buckets)
+    # a later live range still lines up behind the gap
+    quiet[0] = False
+    clock[0] = T0 + 4 * BUCKET_S
+    buckets = tailer.poll()
+    assert len(buckets) == 1 and buckets[0].metrics
+
+
+def test_live_tailer_escalates_deterministic_failures():
+    """404-style deterministic failures raise after N consecutive
+    occurrences instead of retrying forever; transient failures keep
+    retrying but surface a degraded flag; success clears both."""
+    import urllib.error
+
+    import pytest as _pytest
+
+    from deeprest_tpu.data.ingest import LiveEndpointTailer, MetricRule
+
+    mode = ["http404"]
+
+    def fetch(url, timeout_s=0):
+        if mode[0] == "http404":
+            raise urllib.error.HTTPError(url, 404, "not found", {}, None)
+        if mode[0] == "conn":
+            raise urllib.error.URLError("connection refused")
+        return {"status": "success", "data": {"resultType": "matrix",
+                "result": [{"metric": {"__name__": "g", "pod": "a"},
+                            "values": [[float(url.split("start=")[-1]
+                                              .split("&")[0]) + 1.0, "1.0"]]}]}}
+
+    clock = [T0]
+    tailer = LiveEndpointTailer(
+        prom_url="http://stub", bucket_s=BUCKET_S,
+        resource_map={"g": MetricRule("cpu", "gauge")},
+        lag_s=0.0, now=lambda: clock[0], fetch=fetch,
+        max_deterministic_failures=3, max_transient_failures=2)
+    step = [1]
+
+    def advance_and_poll():
+        clock[0] = T0 + step[0] * BUCKET_S
+        step[0] += 1
+        return tailer.poll()
+
+    assert advance_and_poll() == []       # failure 1: retried
+    assert advance_and_poll() == []       # failure 2: retried
+    assert not tailer.degraded or tailer.consecutive_failures >= 2
+    with _pytest.raises(RuntimeError, match="deterministic"):
+        advance_and_poll()                # failure 3: escalates
+
+    # transient failures degrade but never raise
+    mode[0] = "conn"
+    tailer2 = LiveEndpointTailer(
+        prom_url="http://stub", bucket_s=BUCKET_S,
+        resource_map={"g": MetricRule("cpu", "gauge")},
+        lag_s=0.0, now=lambda: clock[0], fetch=fetch,
+        max_deterministic_failures=3, max_transient_failures=2)
+    for _ in range(4):
+        clock[0] += BUCKET_S
+        assert tailer2.poll() == []
+    assert tailer2.degraded
+    mode[0] = "ok"
+    clock[0] += BUCKET_S
+    assert tailer2.poll()                 # success…
+    assert not tailer2.degraded           # …clears the degraded flag
+    assert tailer2.consecutive_failures == 0
