@@ -17,6 +17,10 @@
 #                          the round-5 STASH_GATES x LOOP_ORDER knob A/B
 #                          (read the result, then update the defaults in
 #                          deeprest_tpu/ops/pallas_gru.py if a config wins)
+#   5b. superstep_sweep  — flagship-shape steps/s at S in {1,8,32,epoch}
+#                          (sizes TrainConfig.steps_per_superstep on-chip;
+#                          the committed superstep_sweep.json is the CPU
+#                          dispatch-amortization anchor)
 #   6. sharded step      — pallas-under-GSPMD on the real chip (single chip:
 #                          1x1x1 mesh exercises the jit+shard_map path)
 #   7. month_scale       — month-corpus throughput proof
@@ -60,6 +64,8 @@ else
     --features benchmarks/data/month_10k_features.npz --epochs 12
 fi
 step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r5.json
+step superstep_sweep 1800 python benchmarks/superstep_sweep.py --flagship \
+  --out benchmarks/superstep_sweep_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

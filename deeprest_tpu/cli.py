@@ -70,6 +70,20 @@ def _require_input(args, features_ok: bool = True):
         sys.exit("error: provide --raw" + (" or --features" if features_ok else ""))
 
 
+def _superstep_arg(v: str):
+    """``--steps-per-superstep`` parser: int >= 1, 'auto', or 'epoch'."""
+    if v in ("auto", "epoch"):
+        return v
+    try:
+        n = int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"{v!r} is not an int, 'auto', or 'epoch'")
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"{v} must be >= 1")
+    return n
+
+
 # -- subcommands ------------------------------------------------------------
 
 
@@ -239,7 +253,9 @@ def cmd_train(args) -> int:
                           window_size=args.window, learning_rate=args.lr,
                           train_split=args.split, seed=args.seed,
                           eval_stride=args.window,
-                          checkpoint_dir=args.ckpt_dir or ""),
+                          checkpoint_dir=args.ckpt_dir or "",
+                          device_data=args.device_data,
+                          steps_per_superstep=args.steps_per_superstep),
         mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
@@ -382,7 +398,8 @@ def cmd_stream(args) -> int:
         train=TrainConfig(batch_size=args.batch_size, window_size=args.window,
                           learning_rate=args.lr, seed=args.seed,
                           eval_stride=1, eval_max_cycles=args.eval_holdout,
-                          log_every_steps=0),
+                          log_every_steps=0,
+                          steps_per_superstep=args.steps_per_superstep),
     )
     st = StreamingTrainer(
         cfg,
@@ -702,6 +719,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=0.5)
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--device-data", default="auto",
+                   choices=["auto", "always", "off"],
+                   help="stage the normalized base series in device memory "
+                        "and feed steps by start index (auto skips CPU "
+                        "backends and over-budget corpora)")
+    p.add_argument("--steps-per-superstep", type=_superstep_arg,
+                   default="auto", metavar="N|auto|epoch",
+                   help="train steps fused into one compiled dispatch via "
+                        "lax.scan on the staged path (1 = per-step loop; "
+                        "'epoch' = whole epoch per dispatch; 'auto' sizes "
+                        "from the logging cadence)")
     p.add_argument("--mesh", default=None, metavar="D,E,M",
                    help="device mesh data,expert,model (default 1,1,1; "
                         "multi-host joins via JAX_COORDINATOR_ADDRESS / "
@@ -758,6 +786,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hidden-size", type=int, default=128)
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--steps-per-superstep", type=_superstep_arg,
+                   default="auto", metavar="N|auto|epoch",
+                   help="fused steps per compiled dispatch for the staged "
+                        "fine-tune epochs (1 = per-step loop)")
     p.add_argument("--refresh-buckets", type=int, default=60,
                    help="fine-tune after this many new buckets")
     p.add_argument("--finetune-epochs", type=int, default=2)

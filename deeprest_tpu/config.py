@@ -100,6 +100,30 @@ class TrainConfig:
     # (tests, virtual meshes); "off" always streams from host.
     device_data: str = "auto"
     device_data_max_bytes: int = 4 << 30
+    # Fused multi-step supersteps on the staged (device-resident) path:
+    # the whole epoch's shuffled batch plan ([C, S, B] start indices +
+    # weights, trailing chunk zero-weight padded) ships to device once per
+    # epoch and ``jax.lax.scan`` runs S train steps inside ONE donated jit
+    # dispatch — an epoch becomes ceil(K/S) dispatches instead of K, with
+    # per-step losses accumulated on device and read back once per
+    # superstep.  Bit-identical to the per-step loop (same fold_in(rng,
+    # step) dropout, same step counter; padded steps pass the prior state
+    # through a cond skip branch).  1 = per-step dispatch (the historical
+    # loop); "epoch" = the
+    # whole epoch in one dispatch; "auto" = min(epoch length,
+    # log_every_steps or 32), capped so a plan chunk stays under ~1 MiB.
+    # Ignored when the dataset is not staged (host-feed fallback keeps the
+    # per-step loop).
+    steps_per_superstep: int | str = "auto"
+
+    def __post_init__(self):
+        v = self.steps_per_superstep
+        ok = v in ("auto", "epoch") or (
+            isinstance(v, int) and not isinstance(v, bool) and v >= 1)
+        if not ok:
+            raise ValueError(
+                f"TrainConfig.steps_per_superstep={v!r}: must be 'auto', "
+                f"'epoch', or an int >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

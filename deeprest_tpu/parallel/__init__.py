@@ -14,6 +14,7 @@ from deeprest_tpu.parallel.distributed import (
     global_mesh,
     initialize_distributed,
     process_batch_slice,
+    stage_plan,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "global_mesh",
     "initialize_distributed",
     "process_batch_slice",
+    "stage_plan",
 ]

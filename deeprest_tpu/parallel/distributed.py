@@ -148,6 +148,31 @@ def prefetch_to_device(mesh: Mesh, batches, depth: int = 2):
         yield queue.popleft()
 
 
+def stage_plan(mesh: Mesh, starts: np.ndarray,
+               weights: np.ndarray) -> tuple[jax.Array, jax.Array]:
+    """Ship an epoch's superstep batch plan to device memory once.
+
+    ``starts``/``weights`` are ``[C, S, B]`` (chunks × steps-per-superstep
+    × batch) host arrays from ``Trainer._epoch_plan``.  The batch axis is
+    the TRAILING one and shards over the mesh's ``data`` axis — the
+    in-step gather then produces a data-sharded window batch, keeping the
+    superstep data-parallel exactly like the per-step indexed feed.  On a
+    pod every process passes the same (rng-deterministic) global plan and
+    keeps only its batch slice, mirroring :func:`feed_global_batch`'s
+    contract for the leading axis.
+    """
+    def ship(a: np.ndarray) -> jax.Array:
+        axes = (None,) * (a.ndim - 1) + ("data",)
+        sharding = NamedSharding(mesh, P(*axes))
+        if jax.process_count() == 1:
+            return jax.device_put(a, sharding)
+        local = a[..., process_batch_slice(a.shape[-1])]
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(local))
+
+    return ship(np.asarray(starts)), ship(np.asarray(weights))
+
+
 def gather_to_host(arr: jax.Array) -> np.ndarray:
     """A numpy copy of a possibly cross-host-sharded array on every host
     (eval predictions feeding the host-side MAE report)."""
@@ -166,5 +191,6 @@ __all__ = [
     "feed_global_batch",
     "feed_replicated",
     "prefetch_to_device",
+    "stage_plan",
     "gather_to_host",
 ]

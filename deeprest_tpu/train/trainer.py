@@ -29,10 +29,10 @@ from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
-    feed_global_batch, feed_replicated, gather_to_host, prefetch_to_device,
+    feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
 )
 from deeprest_tpu.parallel.mesh import make_mesh
-from deeprest_tpu.parallel.sharding import shard_params
+from deeprest_tpu.parallel.sharding import param_specs, shard_params
 from deeprest_tpu.train.data import DatasetBundle, eval_window_indices
 from deeprest_tpu.train.metrics import Throughput, mae_report
 
@@ -69,8 +69,44 @@ class Trainer:
         self.throughput = Throughput()
         self._warmed = False       # first-ever step (jit compile) excluded
         self._global_step = 0      # host-side mirror of state.step for logging
+        # Per-step losses of the most recent train_epoch (np [K], one host
+        # readback per epoch/superstep) — the superstep-vs-per-step parity
+        # tests and callers that want the full curve read this.
+        self._last_epoch_losses: np.ndarray | None = None
 
         quantiles = self.model_config.quantiles
+
+        def pin_state(state: TrainState) -> TrainState:
+            """Constrain every leaf to its CANONICAL named sharding: params
+            (and their optimizer mirrors, keyed by the same names) per
+            param_specs, everything else replicated.
+
+            Without this, GSPMD collapses the output params' specs (e.g.
+            P('expert', None) → P() on a trivial mesh axis) and flips
+            committedness, so the step's output state has a different
+            signature than init_state's — the second call then silently
+            compiles a SECOND executable whose fusion can round the last
+            bit differently.  Pinning both init_state and every step
+            output to one signature keeps the jit cache at one executable
+            per step function (the no-recompile probe) and is what makes
+            the superstep scan bit-identical to the per-step loop.
+            """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            pspecs = param_specs(state.params)
+
+            def pin(path, leaf):
+                name = next((p.key for p in reversed(path)
+                             if isinstance(p, jax.tree_util.DictKey)), None)
+                spec = pspecs.get(name)
+                if spec is None or len(spec) != leaf.ndim:
+                    spec = P()
+                return jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(self.mesh, spec))
+
+            return jax.tree_util.tree_map_with_path(pin, state)
+
+        self._pin_state = jax.jit(pin_state)
 
         def train_step(state: TrainState, xb, yb, wb):
             dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -86,8 +122,8 @@ class Trainer:
             updates, opt_state = self.tx.update(grads, state.opt_state)
             params = optax.apply_updates(state.params, updates)
             return (
-                TrainState(step=state.step + 1, params=params,
-                           opt_state=opt_state, rng=state.rng),
+                pin_state(TrainState(step=state.step + 1, params=params,
+                                     opt_state=opt_state, rng=state.rng)),
                 loss,
             )
 
@@ -102,6 +138,44 @@ class Trainer:
             idx = starts[:, None] + jnp.arange(w)[None, :]    # [B, W]
             return train_step(state, x_base[idx], y_base[idx], wb)
 
+        def train_superstep(state: TrainState, x_base, y_base,
+                            starts_plan, weights_plan, chunk):
+            # One donated dispatch = S train steps via lax.scan.  The
+            # whole epoch's [C, S, B] plan is device-resident (stage_plan)
+            # and the chunk index is a TRACED scalar, so every chunk of
+            # every epoch — including the zero-weight-padded trailing one
+            # — reuses one executable.  Padded steps (weights all zero)
+            # take lax.cond's skip branch: the prior state passes through
+            # untouched (step counter, fold_in(rng, step) dropout stream,
+            # params — exactly as if the padding never ran) and the wasted
+            # step compute is skipped outright.  cond rather than a
+            # select over the state: fusing a where into the loop body
+            # changed last-bit rounding of the backward pass, breaking
+            # the bit-exactness contract with the per-step loop; the cond
+            # sub-computation preserves the standalone step's rounding
+            # (verified by tests/test_superstep.py).
+            starts_c = jax.lax.dynamic_index_in_dim(
+                starts_plan, chunk, 0, keepdims=False)       # [S, B]
+            weights_c = jax.lax.dynamic_index_in_dim(
+                weights_plan, chunk, 0, keepdims=False)      # [S, B]
+
+            def body(st, step_plan):
+                starts, wb = step_plan
+
+                def run(s):
+                    s2, loss = train_step_indexed(s, x_base, y_base,
+                                                  starts, wb)
+                    # f32 losses regardless of compute dtype so the skip
+                    # branch's zero matches the run branch's aval.
+                    return s2, loss.astype(jnp.float32)
+
+                def skip(s):
+                    return s, jnp.zeros((), jnp.float32)
+
+                return jax.lax.cond(jnp.any(wb > 0), run, skip, st)
+
+            return jax.lax.scan(body, state, (starts_c, weights_c))
+
         def eval_step(params, xb, yb):
             preds = self.model.apply({"params": params}, xb, deterministic=True)
             loss = pinball_loss(preds, yb, quantiles)
@@ -114,6 +188,7 @@ class Trainer:
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._train_step_indexed = jax.jit(train_step_indexed, donate_argnums=0)
+        self._superstep = jax.jit(train_superstep, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._eval_step_indexed = jax.jit(eval_step_indexed)
         self._predict_step = jax.jit(
@@ -132,10 +207,14 @@ class Trainer:
         variables = self.model.init(init_rng, jnp.asarray(sample_x[:1]))
         params = shard_params(self.mesh, dict(variables["params"]))
         opt_state = jax.jit(self.tx.init)(params)
-        return TrainState(
+        # Pinned through the same jitted constraint the train step applies
+        # to its output, so the first step's input signature equals every
+        # later step's — one executable, bit-stable numerics (see
+        # pin_state in __init__).
+        return self._pin_state(TrainState(
             step=jnp.zeros((), jnp.int32), params=params,
             opt_state=opt_state, rng=train_rng,
-        )
+        ))
 
     # ------------------------------------------------------------------
 
@@ -153,6 +232,58 @@ class Trainer:
                 # smaller than the batch size still yield full batches)
                 sel = np.concatenate([sel, np.resize(order, bs - len(sel))])
             yield sel, weight
+
+    # Per-chunk plan-slice byte cap for steps_per_superstep="auto": at
+    # 8 bytes/step/sample (int32 start + f32 weight) this only binds for
+    # pathologically long log intervals; it keeps the sliced [S, B] feed
+    # buffers (and the per-superstep loss readback) comfortably small.
+    _PLAN_CHUNK_MAX_BYTES = 1 << 20
+
+    def _superstep_len(self, num_steps: int) -> int:
+        """Resolve ``steps_per_superstep`` to a concrete S for this epoch.
+
+        ``"epoch"`` fuses the whole epoch into one dispatch; ``"auto"``
+        balances dispatch amortization against logging granularity
+        (log boundaries are reported at most one superstep late) and the
+        plan-chunk byte cap.  Ints clamp to the epoch length so a single
+        ragged chunk never pads beyond one epoch.
+        """
+        v = self.config.train.steps_per_superstep
+        if v == "epoch":
+            s = num_steps
+        elif v == "auto":
+            log_every = self.config.train.log_every_steps
+            s = min(num_steps, log_every if log_every else 32)
+        else:
+            s = min(int(v), num_steps)
+        cap = max(1, self._PLAN_CHUNK_MAX_BYTES
+                  // (8 * self.config.train.batch_size))
+        return max(1, min(s, cap))
+
+    def _epoch_plan(self, n: int, rng: np.random.Generator,
+                    s: int) -> tuple[np.ndarray, np.ndarray, int]:
+        """The epoch's full shuffled batch plan, superstep-chunked.
+
+        Returns ``(starts [C, S, B] int32, weights [C, S, B] float32,
+        num_steps)`` where ``num_steps = ceil(n / B)`` is the count of
+        REAL steps; the trailing chunk is padded to S with zero-weight
+        steps (starts 0 — in-bounds for the gather, skipped by the
+        superstep's ``lax.cond`` pass-through branch).  Consumes exactly
+        one
+        ``rng.permutation`` like the per-step loop, so the two paths see
+        identical shuffles from a shared rng stream.
+        """
+        bs = self.config.train.batch_size
+        batches = list(self._batches(n, rng))
+        num_steps = len(batches)
+        n_chunks = -(-num_steps // s)
+        starts = np.zeros((n_chunks * s, bs), np.int32)
+        weights = np.zeros((n_chunks * s, bs), np.float32)
+        for i, (sel, w) in enumerate(batches):
+            starts[i] = sel
+            weights[i] = w
+        return (starts.reshape(n_chunks, s, bs),
+                weights.reshape(n_chunks, s, bs), num_steps)
 
     def stage_dataset(self, bundle: DatasetBundle):
         """Ship the normalized base series to HBM for index-gather feeding.
@@ -197,6 +328,12 @@ class Trainer:
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
                     epoch_rng: np.random.Generator,
                     staged=None) -> tuple[TrainState, float]:
+        if staged is not None:
+            num_steps = -(-len(bundle.x_train) // self.config.train.batch_size)
+            s = self._superstep_len(num_steps)
+            if s > 1:
+                return self._train_epoch_superstep(state, bundle, epoch_rng,
+                                                   staged, s)
         log_every = self.config.train.log_every_steps
         losses = []
         steps = 0
@@ -222,15 +359,17 @@ class Trainer:
             def index_batches():
                 # Train window i starts at base row i (stride-1 windows),
                 # so the shuffled selection IS the start-index batch.
+                # Prefetch (feed_global_batch's default axes shard the
+                # leading axis over "data", same as the old explicit feed)
+                # keeps the [B] start/weight copies of step t+1 in flight
+                # behind the step on batch t — the superstep-disabled
+                # fallback overlaps transfer with compute too.
                 for sel, weight in self._batches(len(bundle.x_train),
                                                  epoch_rng):
-                    yield (feed_global_batch(self.mesh,
-                                             sel.astype(np.int32),
-                                             axes=("data",)),
-                           feed_global_batch(self.mesh, weight,
-                                             axes=("data",)))
+                    yield sel.astype(np.int32), weight
 
-            batches = index_batches()
+            batches = prefetch_to_device(self.mesh, index_batches(),
+                                         depth=self.config.train.prefetch_depth)
             run = lambda st, starts, wb: self._train_step_indexed(
                 st, x_base, y_base, starts, wb)
 
@@ -252,7 +391,65 @@ class Trainer:
         jax.block_until_ready(state.params)
         if measuring:
             self.throughput.stop(steps)
-        return state, float(np.mean([float(l) for l in losses]))
+        # One stacked host readback for the epoch mean instead of a
+        # device round-trip per element; f64 accumulation over the f32
+        # per-step values reproduces the historical list-of-floats mean
+        # bit-for-bit.
+        epoch_losses = np.asarray(jnp.stack(losses))
+        self._last_epoch_losses = epoch_losses
+        return state, float(np.mean(epoch_losses, dtype=np.float64))
+
+    def _train_epoch_superstep(self, state: TrainState, bundle: DatasetBundle,
+                               epoch_rng: np.random.Generator, staged,
+                               s: int) -> tuple[TrainState, float]:
+        """Fused epoch driver: ceil(K/S) donated dispatches instead of K.
+
+        The epoch's whole shuffled plan ships to HBM once (stage_plan);
+        each dispatch scans S steps on device and returns the [S] per-step
+        loss vector — one readback per superstep (and none until the epoch
+        mean / a log boundary needs values).  Numerics are bit-identical
+        to the per-step indexed loop: same plan rng, same fold_in(rng,
+        step) stream, padded steps select the prior state.
+        """
+        cfg = self.config.train
+        log_every = cfg.log_every_steps
+        x_base, y_base = staged
+        starts, weights, num_steps = self._epoch_plan(
+            len(bundle.x_train), epoch_rng, s)
+        starts_d, weights_d = stage_plan(self.mesh, starts, weights)
+        measuring = self._warmed
+        if measuring:
+            self.throughput.start()
+        chunk_losses = []
+        steps = 0
+        for c in range(starts.shape[0]):
+            real = min(s, num_steps - c * s)
+            state, losses_c = self._superstep(state, x_base, y_base,
+                                              starts_d, weights_d, c)
+            chunk_losses.append(losses_c)
+            if not self._warmed:
+                # First-ever superstep pays the scan's trace+compile.
+                jax.block_until_ready(losses_c)
+                self._warmed = True
+                self.throughput.start()
+                measuring = True
+            else:
+                steps += real
+            prev = self._global_step
+            self._global_step += real
+            if log_every and prev // log_every != self._global_step // log_every:
+                vals = np.asarray(losses_c)     # one readback, ≥1 boundary
+                for gs in range(prev + 1, self._global_step + 1):
+                    if gs % log_every == 0:
+                        print(f"step {gs}: loss {vals[gs - prev - 1]:.6f}")
+        jax.block_until_ready(state.params)
+        if measuring:
+            self.throughput.stop(steps)
+        # Padding only ever trails the real steps, so [:num_steps] of the
+        # concatenated chunks is exactly the epoch's per-step loss curve.
+        epoch_losses = np.asarray(jnp.concatenate(chunk_losses))[:num_steps]
+        self._last_epoch_losses = epoch_losses
+        return state, float(np.mean(epoch_losses, dtype=np.float64))
 
     # ------------------------------------------------------------------
 
@@ -284,7 +481,7 @@ class Trainer:
         # windows like ``predict`` does; the loss is the window-weighted
         # mean of the per-chunk pinball means.
         bs = cfg.eval_batch_size
-        preds_chunks, loss_sum = [], 0.0
+        preds_chunks, loss_terms = [], []
         for lo in range(0, len(idx), bs):
             sel = idx[lo:lo + bs]
             if staged is not None:
@@ -296,9 +493,12 @@ class Trainer:
                 yb = feed_replicated(self.mesh, bundle.y_test[sel])
                 p, l = self._eval_step(state.params, xb, yb)
             preds_chunks.append(np.asarray(gather_to_host(p)))
-            loss_sum += float(l) * len(sel)
+            # Window-weighted loss accumulates as a DEVICE scalar (f32 even
+            # for bf16 models) — no per-chunk float(l) sync; one readback
+            # after the paging loop.
+            loss_terms.append(l.astype(jnp.float32) * len(sel))
         preds = np.concatenate(preds_chunks, axis=0)
-        loss = loss_sum / len(idx)
+        loss = float(jnp.sum(jnp.stack(loss_terms))) / len(idx)
 
         # Floor the *normalized* median prediction at 1e-6 before
         # de-normalizing — the reference's clamp order (estimate.py:100-103);

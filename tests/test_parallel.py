@@ -52,9 +52,15 @@ def test_sharded_params_placement(bundle):
     mesh = make_mesh(MeshConfig(data=2, expert=2, model=2))
     trainer = Trainer(SMALL, bundle.feature_dim, bundle.metric_names, mesh=mesh)
     state = trainer.init_state(bundle.x_train)
-    # expert axis (size 2 on E=2 metrics) actually distributes
+    # expert axis (size 2 on E=2 metrics) actually distributes.  Specs are
+    # compared semantically, not representationally: init_state pins the
+    # state through the same jitted sharding constraint the train step
+    # applies (one executable for first and steady-state calls), and jit
+    # canonicalizes trailing Nones out of the returned spec.
+    from jax.sharding import NamedSharding
     sh = state.params["gru_fwd_w_ih"].sharding
-    assert sh.spec == P("expert", "model", None)
+    assert sh.is_equivalent_to(
+        NamedSharding(mesh, P("expert", "model", None)), 3)
     assert len(state.params["gru_fwd_w_ih"].devices()) == 8
 
 

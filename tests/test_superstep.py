@@ -1,0 +1,229 @@
+"""Fused multi-step superstep tests: bit-exact parity with the per-step
+loop (staged AND host-feed fallback), ragged-final-chunk correctness, the
+one-executable no-recompile guarantee across epochs, and a tier-1-safe
+2-epoch smoke fit on the synthetic corpus.
+
+The parity bar here is EQUALITY, not allclose: the superstep restructures
+the innermost production loop, and the contract that makes that safe is
+that it changes dispatch granularity only — same shuffle rng, same
+fold_in(rng, step) dropout stream, same update math, bit-for-bit.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+
+from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, TrainConfig
+from deeprest_tpu.data.featurize import featurize_buckets
+from deeprest_tpu.train import Trainer, prepare_dataset
+
+from conftest import make_series_buckets
+
+
+SMALL = Config(
+    model=ModelConfig(hidden_size=8, dropout_rate=0.1),
+    train=TrainConfig(num_epochs=3, batch_size=16, window_size=12,
+                      eval_stride=12, eval_max_cycles=4, seed=0,
+                      device_data="always"),
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    buckets = make_series_buckets(160, seed=2)
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=8))
+    return prepare_dataset(data, SMALL.train)
+
+
+def trainer_with(bundle, **train_kw):
+    cfg = Config(model=SMALL.model,
+                 train=dataclasses.replace(SMALL.train, **train_kw))
+    return Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+
+
+def run_epochs(trainer, bundle, *, epochs, seed=3, staged=False):
+    staged_arrays = trainer.stage_dataset(bundle) if staged else None
+    if staged:
+        assert staged_arrays is not None
+    state = trainer.init_state(bundle.x_train, seed=seed)
+    rng = np.random.default_rng(7)
+    means, per_step = [], []
+    for _ in range(epochs):
+        state, loss = trainer.train_epoch(state, bundle, rng,
+                                          staged=staged_arrays)
+        means.append(loss)
+        per_step.append(trainer._last_epoch_losses.copy())
+    return state, means, per_step
+
+
+def assert_states_bit_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(a.opt_state), jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert int(a.step) == int(b.step)
+
+
+def test_stage_plan_shards_batch_axis():
+    """The staged plan shards its TRAILING (batch) axis over 'data' so the
+    in-scan gather yields a data-parallel window batch."""
+    from deeprest_tpu.config import MeshConfig
+    from deeprest_tpu.parallel import stage_plan
+    from deeprest_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2))
+    starts = np.arange(2 * 3 * 8, dtype=np.int32).reshape(2, 3, 8)
+    weights = np.ones((2, 3, 8), np.float32)
+    s_d, w_d = stage_plan(mesh, starts, weights)
+    assert s_d.shape == (2, 3, 8) and w_d.shape == (2, 3, 8)
+    assert s_d.dtype == np.int32 and w_d.dtype == np.float32
+    # batch axis split across the data axis, leading axes replicated
+    assert s_d.sharding.shard_shape((2, 3, 8)) == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(s_d), starts)
+    np.testing.assert_array_equal(np.asarray(w_d), weights)
+
+
+def test_config_rejects_bad_superstep():
+    with pytest.raises(ValueError, match="steps_per_superstep"):
+        TrainConfig(steps_per_superstep="sometimes")
+    with pytest.raises(ValueError, match="steps_per_superstep"):
+        TrainConfig(steps_per_superstep=0)
+    TrainConfig(steps_per_superstep="auto")
+    TrainConfig(steps_per_superstep="epoch")
+    TrainConfig(steps_per_superstep=8)
+
+
+def test_epoch_plan_shapes_and_padding(bundle):
+    """K=4 steps at S=3 → 2 chunks; pad slots carry zero weight and
+    in-bounds start indices, real slots reproduce _batches exactly."""
+    t = trainer_with(bundle, steps_per_superstep=3)
+    n = len(bundle.x_train)
+    starts, weights, num_steps = t._epoch_plan(n, np.random.default_rng(0), 3)
+    k = -(-n // SMALL.train.batch_size)
+    assert num_steps == k == 4
+    assert starts.shape == weights.shape == (2, 3, SMALL.train.batch_size)
+    flat_s = starts.reshape(-1, SMALL.train.batch_size)
+    flat_w = weights.reshape(-1, SMALL.train.batch_size)
+    # padded trailing slots: all-zero weights, in-bounds starts
+    assert (flat_w[num_steps:] == 0).all()
+    assert (flat_s >= 0).all() and (flat_s < n).all()
+    # real slots match the per-step generator on the same rng stream
+    ref = list(t._batches(n, np.random.default_rng(0)))
+    for i, (sel, w) in enumerate(ref):
+        np.testing.assert_array_equal(flat_s[i], sel.astype(np.int32))
+        np.testing.assert_array_equal(flat_w[i], w)
+    # every real step has at least one live sample; padding has none
+    assert (flat_w[:num_steps].sum(axis=1) > 0).all()
+
+
+def test_superstep_len_resolution(bundle):
+    t = trainer_with(bundle, steps_per_superstep="epoch")
+    assert t._superstep_len(10) == 10
+    t = trainer_with(bundle, steps_per_superstep=32)
+    assert t._superstep_len(10) == 10          # clamps to the epoch
+    assert t._superstep_len(100) == 32
+    t = trainer_with(bundle, steps_per_superstep="auto", log_every_steps=5)
+    assert t._superstep_len(100) == 5          # logging cadence preserved
+    t = trainer_with(bundle, steps_per_superstep="auto", log_every_steps=0)
+    assert t._superstep_len(100) == 32
+    t = trainer_with(bundle, steps_per_superstep=1)
+    assert t._superstep_len(100) == 1
+
+
+def test_superstep_bit_identical_to_per_step_staged(bundle):
+    """Multi-epoch superstep run (S=3, K=4 → ragged final chunk every
+    epoch) must reproduce the staged per-step loop exactly: same per-step
+    losses, same epoch means, same final params/opt state/step counter."""
+    t_step, = [trainer_with(bundle, steps_per_superstep=1)]
+    s_step, means_step, steps_step = run_epochs(t_step, bundle, epochs=3,
+                                                staged=True)
+    t_fused = trainer_with(bundle, steps_per_superstep=3)
+    s_fused, means_fused, steps_fused = run_epochs(t_fused, bundle, epochs=3,
+                                                   staged=True)
+    assert means_fused == means_step
+    for a, b in zip(steps_fused, steps_step):
+        np.testing.assert_array_equal(a, b)
+    assert_states_bit_equal(s_fused, s_step)
+    # the loop really fused: ceil(4/3)=2 dispatches/epoch, counter advanced
+    # by real steps only
+    assert int(s_fused.step) == 3 * 4
+    assert t_fused._global_step == 3 * 4
+
+
+def test_superstep_bit_identical_to_host_feed_fallback(bundle):
+    """The host-feed per-step loop (no staging — what superstep-enabled
+    configs fall back to) trains bit-identically to the fused staged path
+    for f32 models."""
+    t_host = trainer_with(bundle, steps_per_superstep=8)
+    s_host, means_host, _ = run_epochs(t_host, bundle, epochs=2, staged=False)
+    t_fused = trainer_with(bundle, steps_per_superstep=8)
+    s_fused, means_fused, _ = run_epochs(t_fused, bundle, epochs=2,
+                                         staged=True)
+    assert means_fused == means_host
+    assert_states_bit_equal(s_fused, s_host)
+
+
+def test_one_executable_across_epochs_and_ragged_chunks(bundle):
+    """The no-recompile guarantee (the ladder probe's training analog):
+    after the first superstep call, epochs of chunks — full and ragged —
+    plus fresh epoch plans must add ZERO executables."""
+    t = trainer_with(bundle, steps_per_superstep=3)
+    staged = t.stage_dataset(bundle)
+    state = t.init_state(bundle.x_train, seed=3)
+    rng = np.random.default_rng(7)
+    state, _ = t.train_epoch(state, bundle, rng, staged=staged)
+    probe = getattr(t._superstep, "_cache_size", None)
+    if not callable(probe):
+        pytest.skip("jax version exposes no jit cache probe")
+    assert probe() == 1                       # warm: one executable total
+    for _ in range(2):
+        state, _ = t.train_epoch(state, bundle, rng, staged=staged)
+    assert probe() == 1                       # ...and it stays that way
+    # the per-step paths share the guarantee (state signatures are pinned)
+    t1 = trainer_with(bundle, steps_per_superstep=1)
+    staged1 = t1.stage_dataset(bundle)
+    s1 = t1.init_state(bundle.x_train, seed=3)
+    for _ in range(2):
+        s1, _ = t1.train_epoch(s1, bundle, np.random.default_rng(7),
+                               staged=staged1)
+    assert t1._train_step_indexed._cache_size() == 1
+
+
+def test_superstep_epoch_mode_single_dispatch(bundle):
+    """steps_per_superstep='epoch' runs the whole epoch in one dispatch
+    and still matches the per-step loop bit-for-bit."""
+    t_step = trainer_with(bundle, steps_per_superstep=1)
+    s_step, means_step, _ = run_epochs(t_step, bundle, epochs=2, staged=True)
+    t_epoch = trainer_with(bundle, steps_per_superstep="epoch")
+    staged = t_epoch.stage_dataset(bundle)
+    state = t_epoch.init_state(bundle.x_train, seed=3)
+    rng = np.random.default_rng(7)
+    means = []
+    for _ in range(2):
+        state, loss = t_epoch.train_epoch(state, bundle, rng, staged=staged)
+        means.append(loss)
+    assert means == means_step
+    assert_states_bit_equal(state, s_step)
+    # K=4 divides S=4: the plan has exactly one (unpadded) chunk
+    starts, _, num_steps = t_epoch._epoch_plan(len(bundle.x_train),
+                                               np.random.default_rng(0),
+                                               t_epoch._superstep_len(4))
+    assert starts.shape[0] == 1 and num_steps == 4
+
+
+def test_superstep_two_epoch_smoke_fit(bundle):
+    """Tier-1-safe end-to-end: a 2-epoch fit through Trainer.fit with
+    supersteps forced on (device_data='always' stages on the CPU backend),
+    exercising plan staging, the scan driver, eval, and reporting."""
+    t = trainer_with(bundle, steps_per_superstep="auto", num_epochs=2)
+    state, history = t.fit(bundle)
+    assert len(history) == 2
+    assert all(np.isfinite(h.train_loss) for h in history)
+    assert all(np.isfinite(h.test_loss) for h in history)
+    assert set(history[-1].report) == set(bundle.metric_names)
+    assert int(state.step) == 2 * 4
+    # per-step losses surfaced for the epoch (one readback each)
+    assert t._last_epoch_losses.shape == (4,)
+    assert np.isfinite(t._last_epoch_losses).all()
