@@ -528,8 +528,23 @@ def main() -> None:
         print(f"bench: torch baseline failed: {exc}", file=sys.stderr)
         torch_sps = 0.0
 
+    # Host-ETL headline (schema v4, NEW key): vectorized hash-mode
+    # featurization throughput at the flagship F=512 on this host's CPU —
+    # numpy-only, so the parent's never-touch-a-backend contract holds.
+    # benchmarks/etl_bench.py has the full old-vs-new sweep (F=10240,
+    # worker pool, refresh assembly, stream overlap).
+    etl_bps = None
+    try:
+        from benchmarks.etl_bench import quick_buckets_per_sec
+
+        etl_bps = quick_buckets_per_sec()
+    except Exception as exc:
+        print(f"bench: etl measurement failed: {exc}", file=sys.stderr)
+
     perf = _mfu_block(measured, F)
     result = {
+        # v4: etl_buckets_per_sec is the host-ETL featurization headline —
+        # a NEW key, nothing repurposed; every v3 key keeps its meaning.
         # v3: superstep_steps_per_sec (+ superstep_S) is the fused
         # multi-step dispatch driver — a NEW key, nothing repurposed
         # (per round-5 ADVICE); every v2 key keeps its meaning.
@@ -537,7 +552,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 3,
+        "schema_version": 4,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -572,6 +587,8 @@ def main() -> None:
             "window tensors shipped every step — the key's historical "
             "meaning)."),
     }
+    if etl_bps is not None:
+        result["etl_buckets_per_sec"] = round(float(etl_bps), 2)
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

@@ -42,6 +42,8 @@ def make_fwd_call(e_blk_target: int, t_blk: int, bf16_dot: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from deeprest_tpu.ops import pallas_gru
+
     def kernel(proj_ref, w_ref, b_ref, h0_ref, out_ref, h_scr):
         t = pl.program_id(1)
 
@@ -93,7 +95,7 @@ def make_fwd_call(e_blk_target: int, t_blk: int, bf16_dot: bool = False):
                                    lambda i, j: (i, j, 0, 0)),
             out_shape=jax.ShapeDtypeStruct((e, t, b, h), jnp.float32),
             scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_gru.CompilerParams(
                 dimension_semantics=("arbitrary", "arbitrary"),
             ),
         )(proj, w_hh, b_hh, h0)

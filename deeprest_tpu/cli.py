@@ -49,7 +49,8 @@ def _load_features(args):
         capacity=args.capacity, round_to=args.round_to,
         hash_features=args.hash_features,
     )
-    return featurize_buckets(_load_buckets(args.raw), cfg)
+    return featurize_buckets(_load_buckets(args.raw), cfg,
+                             workers=getattr(args, "workers", 1))
 
 
 def _add_input_args(p: argparse.ArgumentParser, features_ok: bool = True):
@@ -374,7 +375,7 @@ def cmd_stream(args) -> int:
     (BASELINE.json config 5; train/stream.py docstring has the
     drift-handling design)."""
     from deeprest_tpu.config import (
-        Config, FeaturizeConfig, ModelConfig, TrainConfig,
+        Config, EtlConfig, FeaturizeConfig, ModelConfig, TrainConfig,
     )
     from deeprest_tpu.train.stream import (
         BucketTailer, StreamConfig, StreamingTrainer,
@@ -400,6 +401,8 @@ def cmd_stream(args) -> int:
                           eval_stride=1, eval_max_cycles=args.eval_holdout,
                           log_every_steps=0,
                           steps_per_superstep=args.steps_per_superstep),
+        etl=EtlConfig(overlap=not args.no_etl_overlap,
+                      queue_depth=args.etl_queue_depth),
     )
     st = StreamingTrainer(
         cfg,
@@ -435,6 +438,9 @@ def cmd_stream(args) -> int:
             "train_loss": round(r.train_loss, 6),
             "eval_loss": round(r.eval_loss, 6),
             "checkpoint": r.checkpoint_path,
+            "etl": {"stall_s": round(r.etl_stall_s, 4),
+                    "lag_buckets": r.etl_lag_buckets,
+                    "dropped": r.etl_dropped},
         }), flush=True)
     return 0
 
@@ -667,6 +673,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("featurize", help="raw corpus → model-ready features")
     _add_input_args(p, features_ok=False)
+    p.add_argument("--workers", type=int, default=1,
+                   help="shard trace walking across a forked process pool "
+                        "(0 = one per CPU, 1 = serial); bit-identical "
+                        "output in both featurization modes")
     p.add_argument("--out", default="input.npz")
     p.set_defaults(fn=cmd_featurize)
 
@@ -806,6 +816,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-holdout", type=int, default=8,
                    help="newest windows held out for eval each refresh")
     p.add_argument("--poll-interval", type=float, default=0.5)
+    p.add_argument("--no-etl-overlap", action="store_true",
+                   help="run tail→parse→featurize inline on the train "
+                        "thread instead of the background ETL thread "
+                        "(same refresh results; only the overlap differs)")
+    p.add_argument("--etl-queue-depth", type=int, default=512,
+                   help="buckets buffered between the ETL thread and the "
+                        "train loop (backpressure bound)")
     p.add_argument("--max-refreshes", type=int, default=0,
                    help="stop after N refreshes (0 = run forever)")
     p.add_argument("--deadline", type=float, default=0,

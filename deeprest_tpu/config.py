@@ -151,6 +151,32 @@ class FeaturizeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class EtlConfig:
+    """Host-ETL pipeline knobs (featurization + streaming ingest).
+
+    The featurization firehose is host-side work (trace walking, hashing,
+    counting) that must keep up with the device (PERF.md "Host ETL"):
+    ``workers`` shards offline corpus featurization across a forked
+    process pool, and ``overlap`` moves the streaming trainer's
+    tail→parse→featurize onto a background thread double-buffered against
+    device fine-tuning, with ``queue_depth`` bounding the featurized-but-
+    not-yet-ingested backlog (backpressure blocks the ETL thread, which
+    in turn stops draining the tailer).
+    """
+
+    workers: int = 1              # offline featurize pool: 1 = serial, 0 = per-CPU
+    queue_depth: int = 512        # buckets buffered between ETL and train threads
+    overlap: bool = True          # background ETL thread in StreamingTrainer.run
+
+    def __post_init__(self):
+        if self.workers < 0:
+            raise ValueError(f"EtlConfig.workers={self.workers}: must be >= 0")
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"EtlConfig.queue_depth={self.queue_depth}: must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device-mesh shape for pjit/GSPMD execution.
 
@@ -176,6 +202,7 @@ class Config:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     featurize: FeaturizeConfig = dataclasses.field(default_factory=FeaturizeConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    etl: EtlConfig = dataclasses.field(default_factory=EtlConfig)
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
@@ -204,6 +231,7 @@ class Config:
             train=build(TrainConfig, d.get("train", {})),
             featurize=build(FeaturizeConfig, d.get("featurize", {})),
             mesh=build(MeshConfig, d.get("mesh", {})),
+            etl=build(EtlConfig, d.get("etl", {})),
         )
 
     @classmethod

@@ -33,6 +33,11 @@ from deeprest_tpu.data.schema import Bucket, Span
 
 CallPath = tuple[str, ...]
 
+# float32 can represent every integer count below 2**24 exactly, which is
+# what makes the vectorized bincount path bit-identical to the historical
+# `x[col] += 1.0` accumulation loop (see CallPathSpace.extract).
+_EXACT_F32_COUNT = 1 << 24
+
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
@@ -75,6 +80,14 @@ class CallPathSpace:
     # Set on first extract (or explicit freeze()); afterwards the vector
     # width never changes even if the vocabulary keeps growing.
     frozen_capacity: int | None = None
+    # Hash-mode memo: call path → column.  Paths repeat massively across
+    # traces and the byte-wise FNV is the dominant per-span cost; one hash
+    # per distinct path amortizes it away.  Only populated after freeze()
+    # (the column depends on the frozen capacity); never serialized — it is
+    # pure cache, rebuilt on demand.  Dictionary mode needs no memo: the
+    # index IS the path→column map.
+    _hash_memo: dict[CallPath, int] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
 
     # -- construction ------------------------------------------------------
 
@@ -130,15 +143,83 @@ class CallPathSpace:
 
     # -- extraction --------------------------------------------------------
 
+    def _trace_columns(self, traces: Sequence[Span]) -> np.ndarray:
+        """int32 column ids, one per counted span, across ``traces``.
+
+        The vectorized core: an explicit-stack preorder walk (no generator
+        frames, no per-visit ``label`` property) that resolves each path to
+        its column via the hash memo (hash mode) or the index (dictionary
+        mode, overflow columns dropped).  Count order is irrelevant — the
+        caller bincounts — so only the multiset of columns must match the
+        reference loop's.  Requires a frozen capacity (extract freezes).
+        """
+        cols: list[int] = []
+        append = cols.append
+        if self.config.hash_features:
+            memo = self._hash_memo
+            memo_get = memo.get
+            cap = self.capacity
+            seed = self.config.hash_seed
+            for trace in traces:
+                stack = [((), trace)]
+                pop, push = stack.pop, stack.append
+                while stack:
+                    prefix, node = pop()
+                    path = prefix + (node.component + "_" + node.operation,)
+                    c = memo_get(path)
+                    if c is None:
+                        c = _stable_hash(path, seed) % cap
+                        memo[path] = c
+                    append(c)
+                    for child in node.children:
+                        push((path, child))
+        else:
+            # The index is already the memo; unknown paths are NOT cached
+            # as dropped — observe() may legally assign them a column later
+            # (the reference loop honors that, so the memo must too).
+            index_get = self.index.get
+            cap = self.capacity
+            for trace in traces:
+                stack = [((), trace)]
+                pop, push = stack.pop, stack.append
+                while stack:
+                    prefix, node = pop()
+                    path = prefix + (node.component + "_" + node.operation,)
+                    idx = index_get(path)
+                    if idx is not None and idx < cap:
+                        append(idx)
+                    for child in node.children:
+                        push((path, child))
+        return np.asarray(cols, dtype=np.int32)
+
     def extract(self, traces: Sequence[Span], out: np.ndarray | None = None) -> np.ndarray:
         """Count each call path across ``traces`` into a [capacity] vector.
 
         Freezes the capacity on first call.  A caller-supplied ``out`` buffer
-        is zeroed first (counts are per-call, never cumulative).  Paths beyond
-        a fixed ``capacity`` in dictionary mode are dropped (counted into
-        nothing) — the documented overflow policy; size the capacity or switch
-        to hashing to avoid it.
+        is fully overwritten (counts are per-call, never cumulative).  Paths
+        beyond a fixed ``capacity`` in dictionary mode are dropped (counted
+        into nothing) — the documented overflow policy; size the capacity or
+        switch to hashing to avoid it.
+
+        Vectorized: column ids are gathered once per span (memoized per
+        path) and accumulated with ``np.bincount``.  Bit-identical to the
+        reference loop (``extract_reference``) for any count below 2**24 —
+        counts are integers and float32 represents those exactly.
         """
+        self.freeze()
+        counts = np.bincount(self._trace_columns(traces),
+                             minlength=self.capacity)
+        if out is not None:
+            out[:] = counts
+            return out
+        return counts.astype(np.float32)
+
+    def extract_reference(self, traces: Sequence[Span],
+                          out: np.ndarray | None = None) -> np.ndarray:
+        """The historical per-span accumulation loop, kept verbatim as the
+        semantic specification of ``extract``: parity tests pin the
+        vectorized path against it bit-for-bit, and benchmarks/etl_bench.py
+        uses it as the old-throughput baseline."""
         self.freeze()
         if out is not None:
             out[:] = 0.0
@@ -272,24 +353,133 @@ class FeaturizedData:
                        space=space)
 
 
+# --------------------------------------------------------------------------
+# Process-parallel featurization (corpus-scale ingest)
+#
+# Two-phase observe→merge→extract over contiguous bucket shards.  Phase 1
+# (dictionary mode only): each worker walks its shard and returns the
+# shard-local first-observed path order; merging shards IN ORDER reproduces
+# the serial first-observed column order exactly — the reference's growth
+# rule (featurize.py:14-15) — because a path's first global occurrence lies
+# in the earliest shard containing it, and within that shard the worker
+# preserved local first-observed order.  Phase 2: workers extract their
+# shard's traffic rows and invocation counts against the merged (frozen)
+# space.  Counts are integers, so the merged result is bit-identical to a
+# serial run.
+#
+# Workers are forked AFTER the corpus (and, for phase 2, the merged space)
+# are bound to module globals: fork inherits them copy-on-write, so the
+# corpus is never pickled to the pool — only the small per-shard results
+# travel back.
+
+_POOL_BUCKETS: Sequence[Bucket] | None = None
+_POOL_SPACE: CallPathSpace | None = None
+
+
+def _observe_shard(span: tuple[int, int]) -> list[CallPath]:
+    lo, hi = span
+    seen: set[CallPath] = set()
+    order: list[CallPath] = []
+    for bucket in _POOL_BUCKETS[lo:hi]:
+        for trace in bucket.traces:
+            for path, _ in trace.walk():
+                if path not in seen:
+                    seen.add(path)
+                    order.append(path)
+    return order
+
+
+def _extract_shard(span: tuple[int, int]) -> tuple[np.ndarray, list[dict[str, int]]]:
+    lo, hi = span
+    chunk = _POOL_BUCKETS[lo:hi]
+    traffic = _POOL_SPACE.extract_buckets(chunk)
+    return traffic, [count_invocations(b.traces) for b in chunk]
+
+
+def _shard_spans(n: int, workers: int) -> list[tuple[int, int]]:
+    per = (n + workers - 1) // workers
+    return [(lo, min(lo + per, n)) for lo in range(0, n, per)]
+
+
+def resolve_workers(workers: int) -> int:
+    """ETL worker-count knob semantics: 0 = one per CPU, 1 = serial."""
+    if workers == 0:
+        import os
+
+        return os.cpu_count() or 1
+    return max(1, workers)
+
+
+def _parallel_featurize(
+    buckets: Sequence[Bucket], space: CallPathSpace, workers: int,
+) -> tuple[np.ndarray, list[dict[str, int]]] | None:
+    """Sharded observe→merge→extract; None when parallelism is unavailable
+    (no fork on this platform) so the caller falls back to serial."""
+    import multiprocessing
+
+    global _POOL_BUCKETS, _POOL_SPACE
+    try:
+        ctx = multiprocessing.get_context("fork")
+    except ValueError:
+        return None
+    spans = _shard_spans(len(buckets), workers)
+    _POOL_BUCKETS = buckets
+    try:
+        if not space.config.hash_features and space.frozen_capacity is None:
+            with ctx.Pool(min(workers, len(spans))) as pool:
+                shard_orders = pool.map(_observe_shard, spans)
+            for order in shard_orders:          # in shard order: serial-exact
+                for path in order:
+                    if path not in space.index:
+                        space.index[path] = len(space.index)
+        space.freeze()
+        _POOL_SPACE = space
+        with ctx.Pool(min(workers, len(spans))) as pool:
+            shard_results = pool.map(_extract_shard, spans)
+    finally:
+        _POOL_BUCKETS = None
+        _POOL_SPACE = None
+    traffic = np.vstack([r[0] for r in shard_results])
+    invocations = [c for r in shard_results for c in r[1]]
+    return traffic, invocations
+
+
 def featurize_buckets(
     buckets: Sequence[Bucket],
     config: FeaturizeConfig | None = None,
     space: CallPathSpace | None = None,
+    workers: int = 1,
 ) -> FeaturizedData:
-    """Full-corpus featurization: traffic, resources, invocation counts."""
+    """Full-corpus featurization: traffic, resources, invocation counts.
+
+    ``workers`` shards the trace-walking work (observe + extract +
+    invocation counts) across a forked process pool: 1 = serial, 0 = one
+    worker per CPU.  Results are bit-identical to serial in both modes
+    (see _parallel_featurize).  Metric-series assembly stays in the parent
+    — it walks no traces and its validation is order-dependent.
+    """
     config = config or FeaturizeConfig()
     if space is None:
         space = CallPathSpace(config=config)
-    # Observe before extracting (no-op in hash mode): a caller-provided
-    # fresh space would otherwise freeze at minimum capacity and silently
-    # drop every path.  Already-frozen spaces are left untouched — novel
-    # eval-corpus paths could never be addressed anyway, and growing the
-    # index across serve-time calls would leak memory.
-    if space.frozen_capacity is None:
-        space.observe(buckets)
 
-    traffic = space.extract_buckets(buckets)
+    workers = resolve_workers(workers)
+    per_bucket_counts: list[dict[str, int]] | None = None
+    traffic: np.ndarray | None = None
+    # Parallelism only pays once walking dominates the fork+merge overhead.
+    if workers > 1 and len(buckets) >= 4 * workers:
+        parallel = _parallel_featurize(buckets, space, workers)
+        if parallel is not None:
+            traffic, per_bucket_counts = parallel
+
+    if traffic is None:
+        # Observe before extracting (no-op in hash mode): a caller-provided
+        # fresh space would otherwise freeze at minimum capacity and silently
+        # drop every path.  Already-frozen spaces are left untouched — novel
+        # eval-corpus paths could never be addressed anyway, and growing the
+        # index across serve-time calls would leak memory.
+        if space.frozen_capacity is None:
+            space.observe(buckets)
+        traffic = space.extract_buckets(buckets)
 
     # Resource series must stay time-aligned with traffic: every bucket has to
     # carry exactly the metric keys of the union, or series would silently
@@ -313,7 +503,8 @@ def featurize_buckets(
                 "bucket must carry the same metrics or series misalign"
             )
 
-    per_bucket_counts = [count_invocations(b.traces) for b in buckets]
+    if per_bucket_counts is None:
+        per_bucket_counts = [count_invocations(b.traces) for b in buckets]
     components = {c for counts in per_bucket_counts for c in counts}
     invocations: dict[str, list[float]] = {c: [] for c in components | {"general"}}
     for c in per_bucket_counts:

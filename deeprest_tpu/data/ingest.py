@@ -33,6 +33,8 @@ import json
 import math
 from typing import Any, Iterable, Mapping, Sequence
 
+import numpy as np
+
 from deeprest_tpu.data.schema import Bucket, MetricSample, Span
 
 # ---------------------------------------------------------------------------
@@ -286,26 +288,37 @@ def bucketize(
         if i is not None:
             trace_buckets[i].append(root)
 
-    # (component, resource, series) → per-bucket accumulators
+    # Vectorized grid placement: one numpy pass computes every sample's
+    # bucket cell with the same floor semantics as the scalar
+    # ``int((ts - lo) // bucket_s)`` (np.floor matches // for negatives).
+    cells = np.empty((0,), np.int64)
+    if samples:
+        ts_all = np.fromiter((s[0] for s in samples), dtype=np.float64,
+                             count=len(samples))
+        cells = np.floor((ts_all - lo) / bucket_s).astype(np.int64)
+
+    # (component, resource, series) → per-bucket accumulators.  Gauges
+    # collect (cell, value) pairs and reduce with np.add.at/bincount below
+    # — same f64 accumulation in the same sample order as the historical
+    # scalar loop, so results are bit-identical; counters keep the
+    # sequential reset-tolerant walk (inherently order-dependent).
     SKey = tuple  # (comp, res, series_id)
-    gauge_sum: dict[SKey, list[float]] = {}
-    gauge_cnt: dict[SKey, list[int]] = {}
+    gauge_pts: dict[SKey, list[tuple[int, float]]] = {}
     counter_vals: dict[SKey, list[list[tuple[float, float]]]] = {}
     modes: dict[SKey, str] = {}
-    for sample in samples:
+    for k, sample in enumerate(samples):
+        i = int(cells[k])
+        if not 0 <= i < n:
+            continue
         ts, comp, res, val, mode = sample[:5]
         sid = sample[5] if len(sample) > 5 else ""
-        i = idx(ts)
-        if i is None:
-            continue
         skey = (comp, res, sid)
         modes[skey] = mode
         if mode == "counter":
             counter_vals.setdefault(skey, [[] for _ in range(n)])[i].append(
                 (ts, val))
         else:
-            gauge_sum.setdefault(skey, [0.0] * n)[i] += val
-            gauge_cnt.setdefault(skey, [0] * n)[i] += 1
+            gauge_pts.setdefault(skey, []).append((i, val))
 
     values: dict[tuple[str, str], list[float]] = {}
     for skey, mode in modes.items():
@@ -328,11 +341,13 @@ def bucketize(
                 vals[i] = inc
                 prev_last = last if last is not None else prev_last
         else:
-            vals = [
-                gauge_sum[skey][i] / gauge_cnt[skey][i]
-                if gauge_cnt[skey][i] else 0.0
-                for i in range(n)
-            ]
+            pts = gauge_pts[skey]
+            cell_idx = np.fromiter((p[0] for p in pts), np.int64, len(pts))
+            pt_vals = np.fromiter((p[1] for p in pts), np.float64, len(pts))
+            sums = np.zeros((n,), np.float64)
+            np.add.at(sums, cell_idx, pt_vals)
+            cnts = np.bincount(cell_idx, minlength=n)
+            vals = np.where(cnts > 0, sums / np.maximum(cnts, 1), 0.0).tolist()
         key = (skey[0], skey[1])
         acc = values.setdefault(key, [0.0] * n)
         for i in range(n):

@@ -39,6 +39,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 import os as _os
 
+# jax renamed pltpu.TPUCompilerParams → pltpu.CompilerParams; the fields
+# used here (dimension_semantics) exist under both names.  Resolve once so
+# the kernels trace on either side of the rename.
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # Experts per kernel program: amortizes grid overhead while keeping
 # VMEM residency (W_hh alone is E_BLK * H * 3H * 4B).  Env-overridable
 # (DEEPREST_GRU_E_BLK) so on-chip sweeps can A/B without code edits.
@@ -269,7 +275,7 @@ def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((e_blk, b, h), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -447,7 +453,7 @@ def _bwd_call(proj, h_prev_all, gates_all, w_hh, b_hh, dout, interpret):
             pltpu.VMEM((e_blk, g3), jnp.float32),
             pltpu.VMEM((e_blk, t_blk, b, g3), _dot_dtype_for(proj.dtype)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

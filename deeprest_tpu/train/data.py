@@ -21,6 +21,75 @@ from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
 
 
 
+class SeriesRing:
+    """Bounded row history as one preallocated, always-contiguous block.
+
+    The streaming trainer's retained corpus was a ``deque[np.ndarray]``:
+    every refresh re-stacked the whole history (O(history) Python-level
+    copies) before it could window.  This ring keeps the newest ``maxlen``
+    rows physically contiguous inside a ``[2·maxlen, width]`` buffer —
+    ``view()`` is a zero-copy slice that ``sliding_windows`` strides over
+    directly, so refresh-time assembly is O(1) and the per-append cost is
+    amortized O(width) (one block memmove per ``maxlen`` appends when the
+    write cursor hits the end).
+
+    ``append_slot()`` exposes the next row for in-place writes
+    (``extract(out=...)``) so the ingest path allocates nothing.  Rows
+    handed out by ``view()``/iteration are views into the buffer: valid
+    until ~maxlen further appends (the compaction memmove), so consumers
+    that outlive the refresh they were built in must copy.
+    """
+
+    def __init__(self, maxlen: int, width: int, dtype=np.float32):
+        if maxlen < 1:
+            raise ValueError(f"maxlen must be >= 1, got {maxlen}")
+        self.maxlen = maxlen
+        self._buf = np.zeros((2 * maxlen, width), dtype)
+        self._start = 0
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    @property
+    def width(self) -> int:
+        return self._buf.shape[1]
+
+    def append_slot(self) -> np.ndarray:
+        """Advance the ring by one row and return it for in-place writing.
+
+        The returned row holds stale bytes — callers must fully overwrite
+        it (``extract(out=...)`` does)."""
+        if self._start + self._len == len(self._buf):
+            # Cursor at the physical end: memmove the retained rows to the
+            # front.  Here len == maxlen (eviction keeps len <= maxlen and
+            # the buffer is 2*maxlen), so source and destination are the
+            # disjoint halves.
+            self._buf[:self._len] = self._buf[self._start:self._start + self._len]
+            self._start = 0
+        if self._len == self.maxlen:
+            self._start += 1          # evict the oldest row
+            self._len -= 1
+        row = self._buf[self._start + self._len]
+        self._len += 1
+        return row
+
+    def append(self, row: np.ndarray) -> None:
+        self.append_slot()[:] = row
+
+    def view(self) -> np.ndarray:
+        """Zero-copy contiguous ``[len, width]`` of the retained history,
+        oldest first.  Invalidated by later appends (see class docstring)."""
+        return self._buf[self._start:self._start + self._len]
+
+    def __iter__(self):
+        return iter(self.view())
+
+    def clear(self) -> None:
+        self._start = 0
+        self._len = 0
+
+
 def delta_mask(metric_names: Sequence[str],
                resources: Sequence[str]) -> np.ndarray:
     """Boolean [E] mask of metrics (named ``component_resource``) whose

@@ -64,6 +64,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Callable, Iterator
@@ -74,7 +75,9 @@ from deeprest_tpu.config import Config, FeaturizeConfig
 from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.data.schema import Bucket
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
-from deeprest_tpu.train.data import DatasetBundle, delta_mask, to_increments
+from deeprest_tpu.train.data import (
+    DatasetBundle, SeriesRing, delta_mask, to_increments,
+)
 from deeprest_tpu.train.trainer import Trainer, TrainState
 
 
@@ -323,6 +326,18 @@ class RefreshResult:
     train_loss: float
     eval_loss: float
     checkpoint_path: str | None
+    # Host-ETL health counters (filled by run(); zero for direct refresh()
+    # calls).  etl_stall_s is the train thread's host-ETL cost since the
+    # previous refresh: with overlap OFF it is time spent featurizing
+    # inline; with overlap ON it is time spent blocked on the ETL queue
+    # for data that did arrive (idle waits on a quiet source don't count —
+    # that is the source's cadence, not ETL falling behind).
+    etl_stall_s: float = 0.0
+    # Buckets featurized by the ETL thread but not yet ingested when this
+    # refresh started (queue depth = how far ETL ran ahead; 0 when serial).
+    etl_lag_buckets: int = 0
+    # Cumulative malformed lines dropped by the tailer.
+    etl_dropped: int = 0
 
 
 class StreamingTrainer:
@@ -346,8 +361,18 @@ class StreamingTrainer:
         self.stream = stream
         self.ckpt_dir = ckpt_dir
         self.space = CallPathSpace(config=fc).freeze()
-        self.traffic: deque[np.ndarray] = deque(maxlen=stream.history_max)
+        # Retained corpus: preallocated contiguous rings (train/data.py
+        # SeriesRing), not deques of per-bucket arrays — ingest featurizes
+        # straight into the traffic ring's next slot (zero allocation on
+        # the poll/ETL path) and refresh() windows the zero-copy contiguous
+        # views in O(1) instead of re-stacking O(history) rows.
+        self.traffic = SeriesRing(stream.history_max, self.space.capacity)
         self.metrics: deque[dict[str, float]] = deque(maxlen=stream.history_max)
+        # Targets ring mirrors the metrics deque as float32 rows once the
+        # metric set freezes (same [t, i] = v writes _targets() used to do
+        # per refresh, done once per bucket instead of once per refresh).
+        self._target_ring: SeriesRing | None = None
+        self._name_pos: dict[str, int] | None = None
         self.metric_names: list[str] | None = None
         self.trainer: Trainer | None = None
         self.state: TrainState | None = None
@@ -370,13 +395,65 @@ class StreamingTrainer:
     # -- ingestion ------------------------------------------------------
 
     def ingest(self, bucket: Bucket) -> None:
-        self.traffic.append(self.space.extract(bucket.traces))
-        self.metrics.append({m.key: m.value for m in bucket.metrics})
+        # extract(out=...) fills the ring's next slot in place: no fresh
+        # [capacity] float32 per bucket on the poll thread.
+        self.space.extract(bucket.traces, out=self.traffic.append_slot())
+        self._commit_metrics({m.key: m.value for m in bucket.metrics})
+
+    def _featurize(self, bucket: Bucket) -> tuple[np.ndarray, dict[str, float]]:
+        """Featurize off the train thread (overlap mode): the returned row
+        is owned by the caller and committed later via _ingest_featurized,
+        so the shared rings are only ever touched by the train thread."""
+        return (self.space.extract(bucket.traces),
+                {m.key: m.value for m in bucket.metrics})
+
+    def _ingest_featurized(
+            self, feat: tuple[np.ndarray, dict[str, float]]) -> None:
+        row, metrics_row = feat
+        self.traffic.append_slot()[:] = row
+        self._commit_metrics(metrics_row)
+
+    def _commit_metrics(self, row: dict[str, float]) -> None:
+        self.metrics.append(row)
+        if self._target_ring is not None:
+            self._append_target_row(row)
         self._pending += 1
+
+    def _append_target_row(self, row: dict[str, float]) -> None:
+        slot = self._target_ring.append_slot()
+        slot[:] = 0.0
+        for k, v in row.items():
+            i = self._name_pos.get(k)
+            if i is None:
+                if k not in self._warned_new_metrics:
+                    self._warned_new_metrics.add(k)
+                    print(f"stream: metric {k!r} appeared after the "
+                          "metric set froze; dropping it")
+                continue
+            slot[i] = v
 
     @property
     def num_buckets(self) -> int:
         return len(self.traffic)
+
+    def clear_history(self) -> None:
+        """Drop every retained bucket (traffic, metrics, targets) while
+        keeping the frozen metric set, stats, and model state — the
+        history-rotation scenario the quiet-column stats policy covers."""
+        self.traffic.clear()
+        self.metrics.clear()
+        if self._target_ring is not None:
+            self._target_ring.clear()
+
+    def _ensure_target_ring(self) -> None:
+        """Build the float32 targets ring for the frozen metric set and
+        backfill it from the retained metric dicts (one-time O(history);
+        every later bucket appends incrementally)."""
+        self._name_pos = {n: i for i, n in enumerate(self.metric_names)}
+        self._target_ring = SeriesRing(self.stream.history_max,
+                                       len(self.metric_names))
+        for row in self.metrics:
+            self._append_target_row(row)
 
     def _freeze_metrics(self) -> list[str]:
         if self.metric_names is None:
@@ -384,23 +461,19 @@ class StreamingTrainer:
             for row in self.metrics:
                 union |= set(row)
             self.metric_names = sorted(union)
+            self._ensure_target_ring()
         return self.metric_names
 
     def _targets(self) -> np.ndarray:
-        names = self._freeze_metrics()
-        out = np.zeros((len(self.metrics), len(names)), np.float32)
-        name_pos = {n: i for i, n in enumerate(names)}
-        for t, row in enumerate(self.metrics):
-            for k, v in row.items():
-                i = name_pos.get(k)
-                if i is None:
-                    if k not in self._warned_new_metrics:
-                        self._warned_new_metrics.add(k)
-                        print(f"stream: metric {k!r} appeared after the "
-                              "metric set froze; dropping it")
-                    continue
-                out[t, i] = v
-        return out
+        """Zero-copy [T, E] float32 target matrix for the retained corpus.
+
+        Incrementally maintained (_append_target_row writes the identical
+        ``out[t, i] = v`` float32 conversions the historical per-refresh
+        rebuild performed, so the matrix is bit-identical to a full
+        recompute — tests/test_stream.py pins this).  Valid until the next
+        ingest (SeriesRing.view contract)."""
+        self._freeze_metrics()
+        return self._target_ring.view()
 
     # -- refresh --------------------------------------------------------
 
@@ -413,7 +486,12 @@ class StreamingTrainer:
     def refresh(self) -> RefreshResult:
         """Fine-tune on the retained corpus; returns the refresh record."""
         w = self.config.train.window_size
-        traffic = np.stack(list(self.traffic))
+        # Zero-copy contiguous views of the retained corpus (SeriesRing):
+        # assembly is O(1) where the deque-era np.stack + per-dict target
+        # rebuild were O(history).  Both views are consumed (normalized or
+        # windowed into device arrays) before refresh returns, within the
+        # rings' validity window.
+        traffic = self.traffic.view()
         raw_targets = self._targets()
         # Level-type resources train as per-bucket increments (the same
         # transform prepare_dataset applies — train/data.py).  Recomputed
@@ -545,6 +623,7 @@ class StreamingTrainer:
                 f"checkpoint feature_dim {feature_dim} != "
                 f"stream capacity {self.space.capacity}")
         self.metric_names = list(extra["metric_names"])
+        self._ensure_target_ring()
         self.x_stats = MinMaxStats.from_dict(extra["x_stats"])
         self.y_stats = MinMaxStats.from_dict(extra["y_stats"])
         # The delta mask the checkpoint was trained with.  Pre-delta
@@ -595,19 +674,54 @@ class StreamingTrainer:
         resumed stream's persisted lifetime counter affects numbering
         only, so re-running the same bounded command always does the same
         amount of work.
+
+        With ``Config.etl.overlap`` (default on) the tail→parse→featurize
+        work runs on a background ETL thread, double-buffered against the
+        device fine-tune: while refresh() trains, the ETL thread keeps
+        draining the tailer into a bounded featurized-bucket queue
+        (backpressure: a full queue blocks the ETL thread, which stops
+        consuming the tailer), so the train thread ingests precomputed
+        rows instead of stalling on host ETL.  Refresh BOUNDARIES are
+        identical to the serial path: poll batches stay atomic through
+        the queue and readiness is checked once per batch, exactly as the
+        serial loop does — same buckets in, same refresh results out
+        (tests/test_stream.py pins this determinism).
         """
+        if getattr(self.config, "etl", None) is not None \
+                and self.config.etl.overlap:
+            yield from self._run_overlapped(tailer, max_refreshes,
+                                            should_stop, deadline_s)
+        else:
+            yield from self._run_serial(tailer, max_refreshes,
+                                        should_stop, deadline_s)
+
+    def _finish_refresh(self, stall_s: float, lag: int,
+                        tailer) -> RefreshResult:
+        r = self.refresh()
+        r.etl_stall_s = stall_s
+        r.etl_lag_buckets = lag
+        r.etl_dropped = int(getattr(tailer, "dropped", 0))
+        return r
+
+    def _run_serial(self, tailer, max_refreshes, should_stop,
+                    deadline_s) -> Iterator[RefreshResult]:
         t0 = time.monotonic()
         performed = 0
+        stall = 0.0     # train-thread time spent featurizing since last refresh
         while True:
             if should_stop is not None and should_stop():
                 return
             if deadline_s is not None and time.monotonic() - t0 > deadline_s:
                 return
             got = tailer.poll()
-            for bucket in got:
-                self.ingest(bucket)
+            if got:
+                w0 = time.monotonic()
+                for bucket in got:
+                    self.ingest(bucket)
+                stall += time.monotonic() - w0
             if self.ready():
-                yield self.refresh()
+                yield self._finish_refresh(stall, 0, tailer)
+                stall = 0.0
                 performed += 1
                 if max_refreshes is not None and performed >= max_refreshes:
                     return
@@ -615,6 +729,120 @@ class StreamingTrainer:
                 # Sleep only when caught up — while draining a cold-start
                 # backlog the next poll should run immediately.
                 time.sleep(self.stream.poll_interval_s)
+
+    def _run_overlapped(self, tailer, max_refreshes, should_stop,
+                        deadline_s) -> Iterator[RefreshResult]:
+        depth = self.config.etl.queue_depth
+        buf = _EtlBuffer(max_buckets=depth)
+        stop = threading.Event()
+
+        def etl_loop():
+            try:
+                while not stop.is_set():
+                    got = tailer.poll()
+                    if got:
+                        # One queue item per poll batch, kept atomic so the
+                        # train thread's readiness checks land on the same
+                        # batch boundaries as the serial loop's.
+                        buf.put([self._featurize(b) for b in got], stop)
+                    elif not getattr(tailer, "backlog", False):
+                        stop.wait(self.stream.poll_interval_s)
+            except BaseException as exc:  # deterministic tailer failures etc.
+                buf.fail(exc)
+            else:
+                buf.fail(None)            # clean exit (stop requested)
+
+        thread = threading.Thread(target=etl_loop, name="deeprest-etl",
+                                  daemon=True)
+        thread.start()
+        t0 = time.monotonic()
+        performed = 0
+        stall = 0.0     # train-thread time blocked on ETL since last refresh
+        try:
+            while True:
+                if should_stop is not None and should_stop():
+                    return
+                if deadline_s is not None \
+                        and time.monotonic() - t0 > deadline_s:
+                    return
+                w0 = time.monotonic()
+                batch = buf.get(timeout=self.stream.poll_interval_s)
+                if batch:
+                    # Only waits that produced data count as ETL stall —
+                    # an idle timeout is the source's cadence, not the
+                    # featurizer falling behind.
+                    stall += time.monotonic() - w0
+                    for feat in batch:
+                        self._ingest_featurized(feat)
+                if self.ready():
+                    yield self._finish_refresh(stall, buf.pending(), tailer)
+                    stall = 0.0
+                    performed += 1
+                    if max_refreshes is not None \
+                            and performed >= max_refreshes:
+                        return
+        finally:
+            stop.set()
+            buf.unblock()
+            thread.join(timeout=10.0)
+
+
+class _EtlBuffer:
+    """Bounded handoff between the ETL thread and the train loop.
+
+    Items are whole poll batches (lists of featurized buckets); the bound
+    is in BUCKETS — ``put`` blocks while the queued bucket count is at the
+    limit (backpressure), but always admits at least one batch so a poll
+    larger than the whole budget cannot deadlock.  Exceptions from the ETL
+    thread are re-raised from ``get`` once the queue drains, so a
+    deterministic tailer failure still surfaces to the caller.
+    """
+
+    def __init__(self, max_buckets: int):
+        self.max_buckets = max_buckets
+        self._cv = threading.Condition()
+        self._batches: deque[list] = deque()
+        self._buckets = 0
+        self._exc: BaseException | None = None
+        self._closed = False
+
+    def put(self, batch: list, stop: threading.Event) -> None:
+        with self._cv:
+            while self._buckets >= self.max_buckets and not stop.is_set():
+                self._cv.wait(0.05)
+            if stop.is_set():
+                return
+            self._batches.append(batch)
+            self._buckets += len(batch)
+            self._cv.notify_all()
+
+    def get(self, timeout: float) -> list | None:
+        with self._cv:
+            if not self._batches and self._exc is None and not self._closed:
+                self._cv.wait(timeout)
+            if self._batches:
+                batch = self._batches.popleft()
+                self._buckets -= len(batch)
+                self._cv.notify_all()
+                return batch
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            return None
+
+    def fail(self, exc: BaseException | None) -> None:
+        with self._cv:
+            self._exc = exc
+            self._closed = True
+            self._cv.notify_all()
+
+    def pending(self) -> int:
+        with self._cv:
+            return self._buckets
+
+    def unblock(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
 
 
 __all__ = [

@@ -21,9 +21,18 @@ from conftest import make_series_buckets
 # file belongs to the slow tier (README: testing tiers).
 pytestmark = pytest.mark.slow
 
+# 15 epochs, not 5: the final beats-the-baseline assertion has no
+# mathematical guarantee mid-descent — at 5 epochs the seed-0 run sits
+# right at the resrc baseline and small cross-platform numeric drift
+# (BLAS kernel choice, XLA fusion order) flipped the comparison
+# (seed-reproducible flake).  By 15 epochs this model/corpus has
+# converged (12.2/15/20-epoch medians are identical to 3 significant
+# digits) with a ~25% margin over the history baseline, which is far
+# outside float32 reduction-order noise.  The rng streams were already
+# pinned (seed=0 end to end); the fix is asserting only at convergence.
 CFG = Config(
     model=ModelConfig(hidden_size=8, dropout_rate=0.1),
-    train=TrainConfig(num_epochs=5, batch_size=16, window_size=12,
+    train=TrainConfig(num_epochs=15, batch_size=16, window_size=12,
                       eval_stride=12, eval_max_cycles=4, seed=0),
 )
 

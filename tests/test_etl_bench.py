@@ -1,0 +1,54 @@
+"""Tier-1 smoke for the host-ETL benchmark harness: `etl_bench.py --quick`
+must run end to end on every suite pass so the vectorized featurization
+path and the bench's own plumbing cannot rot between full bench runs.
+CPU/numpy-only — the quick tier never touches a JAX backend."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "etl_bench.py")
+
+
+def test_quick_mode_emits_sound_json(tmp_path):
+    out = tmp_path / "etl_bench.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    # stdout's last line and the --out file carry the same record
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.load(open(out)) == result
+    assert result["schema_version"] == 1
+    assert result["quick"] is True
+    (feat,) = result["featurize"]
+    assert feat["mode"] == "hash" and feat["capacity"] == 512
+    assert feat["buckets"] > 0 and feat["spans"] > 0
+    assert feat["loop_buckets_per_sec"] > 0
+    assert feat["vectorized_buckets_per_sec"] > 0
+    # The point of the vectorized path.  The full bench bar is >=5x at
+    # F=10240 (measured ~30x); >1 here keeps the smoke robust to a noisy
+    # shared-CI host while still catching a silent fallback to the loop.
+    assert feat["speedup"] > 1.0
+    asm = result["refresh_assembly"]
+    assert asm["new_ms"] < asm["old_ms"]
+
+
+def test_quick_buckets_per_sec_importable_without_jax_backend():
+    """bench.py's parent process imports this helper; it must stay
+    numpy-only (the bench's never-init-a-backend resilience contract)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '.');"
+         "from benchmarks.etl_bench import quick_buckets_per_sec;"
+         "bps = quick_buckets_per_sec(buckets=5);"
+         "import jax._src.xla_bridge as xb;"
+         "assert not xb._backends, 'quick path initialized a JAX backend';"
+         "print(bps)"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert float(proc.stdout.strip()) > 0

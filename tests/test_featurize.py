@@ -105,6 +105,113 @@ def test_featurize_buckets_shapes(toy_buckets):
         assert data.invocations["general"][t] == len(bucket.traces)
 
 
+# ---------------------------------------------------------------------------
+# Vectorized / parallel featurization: bit-parity with the reference loop
+# (the perf path must be invisible to every consumer — SURVEY.md §4).
+
+
+def _sim_corpus(n=40):
+    from deeprest_tpu.workload import normal_scenario, simulate_corpus
+
+    scn = normal_scenario(0)
+    scn.calls_per_user = 0.4
+    return simulate_corpus(scn, n)
+
+
+@pytest.mark.parametrize("cfg", [
+    FeaturizeConfig(round_to=32),
+    FeaturizeConfig(capacity=16),                      # dict-mode overflow
+    FeaturizeConfig(hash_features=True, capacity=96, hash_seed=1234),
+    FeaturizeConfig(hash_features=True, capacity=10240),
+], ids=["dict", "dict-overflow", "hash", "hash-10k"])
+def test_vectorized_extract_matches_reference_loop(cfg):
+    buckets = _sim_corpus()
+    vec = CallPathSpace(config=cfg)
+    ref = CallPathSpace(config=cfg)
+    if not cfg.hash_features:
+        vec.observe(buckets)
+        ref.observe(buckets)
+    for bucket in buckets:
+        np.testing.assert_array_equal(vec.extract(bucket.traces),
+                                      ref.extract_reference(bucket.traces))
+    # extract(out=...) must fully overwrite the reused buffer.
+    out = np.full((vec.capacity,), 7.0, np.float32)
+    got = vec.extract(buckets[0].traces, out=out)
+    assert got is out
+    np.testing.assert_array_equal(out, ref.extract_reference(buckets[0].traces))
+
+
+def test_dict_mode_path_observed_after_freeze_still_counts():
+    """The reference loop counts a path that observe() assigns a column
+    AFTER the capacity froze (space not yet full); the memoized path must
+    not have cached it as dropped."""
+    first = Span("a", "/op")
+    late = Span("b", "/new")
+    space = CallPathSpace(config=FeaturizeConfig(capacity=8))
+    space.observe([first])
+    x0 = space.extract([late])            # unknown: dropped (capacity frozen)
+    assert x0.sum() == 0
+    space.observe([late])                 # now observed, column 1 < capacity
+    ref = CallPathSpace.from_dict(space.to_dict())
+    np.testing.assert_array_equal(space.extract([late]),
+                                  ref.extract_reference([late]))
+    assert space.extract([late]).sum() == 1
+
+
+@pytest.mark.parametrize("cfg", [
+    FeaturizeConfig(round_to=32),
+    FeaturizeConfig(hash_features=True, capacity=96, hash_seed=9),
+], ids=["dict", "hash"])
+def test_parallel_featurize_bit_identical(cfg):
+    buckets = _sim_corpus()
+    serial = featurize_buckets(buckets, cfg)
+    parallel = featurize_buckets(buckets, cfg, workers=3)
+    assert parallel.space.vocabulary() == serial.space.vocabulary()
+    assert parallel.space.capacity == serial.space.capacity
+    np.testing.assert_array_equal(parallel.traffic, serial.traffic)
+    assert set(parallel.resources) == set(serial.resources)
+    for k in serial.resources:
+        np.testing.assert_array_equal(parallel.resources[k],
+                                      serial.resources[k])
+    assert set(parallel.invocations) == set(serial.invocations)
+    for k in serial.invocations:
+        np.testing.assert_array_equal(parallel.invocations[k],
+                                      serial.invocations[k])
+
+
+# Golden FNV-1a vectors: the wire format native/featurizer.cpp implements
+# byte-for-byte (seeded offset mix, \x1f-joined UTF-8 path).  Committed as
+# constants so NEITHER implementation can drift silently — test_native.py
+# additionally cross-checks the live C++ build where it exists.
+GOLDEN_HASHES = [
+    (("a_/op",), 0x5EED, 0x267F5D0AF14CE5E2),
+    (("a_/op", "b_/x"), 0x5EED, 0x2D695A7BD72FF9BF),
+    (("nginx-thrift_/wrk2-api/post/compose",), 7, 0xB90C66B5AA4F17A3),
+    (("ünïcode_/päth",), 99, 0x03B0AB79FC6FC3DB),
+    (("gateway_/compose", "store-svc_/store", "store-db_/insert"),
+     0x5EED, 0xBEC2695AF78E0A04),
+]
+
+
+def test_stable_hash_golden_vectors():
+    from deeprest_tpu.data.featurize import _stable_hash
+
+    for path, seed, expect in GOLDEN_HASHES:
+        assert _stable_hash(path, seed) == expect, (path, seed)
+
+
+def test_hash_memo_survives_serialization_round_trip():
+    """from_dict must rebuild a space whose (memoized) extraction matches
+    the original's — the memo is cache, never state."""
+    cfg = FeaturizeConfig(hash_features=True, capacity=64, hash_seed=3)
+    buckets = _sim_corpus(8)
+    a = CallPathSpace(config=cfg)
+    warm = [a.extract(b.traces) for b in buckets]      # memo populated
+    b = CallPathSpace.from_dict(a.to_dict())
+    for bucket, x in zip(buckets, warm):
+        np.testing.assert_array_equal(b.extract(bucket.traces), x)
+
+
 @pytest.mark.skipif(not os.path.exists(REFERENCE_TOY), reason="reference fixture absent")
 def test_reference_toy_contract_compat():
     buckets = load_raw_data(REFERENCE_TOY)

@@ -247,9 +247,9 @@ def test_quiet_column_keeps_own_scale():
     union_before = np.asarray(st.x_union.max[0]).copy()
     glob = float(union_before.max())
 
-    # Phase 2: compose traffic disappears entirely from retained history.
-    st.traffic.clear()
-    st.metrics.clear()
+    # Phase 2: compose traffic disappears entirely from retained history
+    # (clear_history drops traffic + metrics + the targets ring together).
+    st.clear_history()
     for b in make_series_buckets(40, seed=9):
         b.traces = [t for t in b.traces if t.operation == "/read"]
         st.ingest(b)
@@ -261,6 +261,161 @@ def test_quiet_column_keeps_own_scale():
     assert quiet.any()                       # compose columns went quiet
     after = np.asarray(st.x_stats.max[0])
     np.testing.assert_allclose(after[quiet], union_before[quiet])
+
+
+# ---------------------------------------------------------------------------
+# Host-ETL pipeline: ring-buffer state, incremental parity, overlapped thread
+
+
+def test_series_ring_matches_deque_reference():
+    """SeriesRing must agree with a deque(maxlen) across fill, eviction,
+    wrap-around compaction, and clear."""
+    from collections import deque as _deque
+
+    from deeprest_tpu.train.data import SeriesRing
+
+    rng = np.random.default_rng(0)
+    ring = SeriesRing(maxlen=7, width=3)
+    ref = _deque(maxlen=7)
+    for i in range(40):                      # > 2*maxlen: exercises compaction
+        row = rng.random(3).astype(np.float32)
+        ring.append_slot()[:] = row
+        ref.append(row)
+        got = ring.view()
+        assert got.flags.c_contiguous and len(got) == len(ref)
+        np.testing.assert_array_equal(got, np.stack(list(ref)))
+        if i == 25:
+            ring.clear()
+            ref.clear()
+    np.testing.assert_array_equal(np.stack(list(ring)), np.stack(list(ref)))
+
+
+def test_incremental_rings_match_full_recompute():
+    """The incrementally-maintained traffic/target rings must be
+    bit-identical to a from-scratch recompute of the retained corpus —
+    including across eviction (history_max exceeded), the pre-freeze
+    target backfill, and late-metric drops."""
+    st = make_trainer(history_max=24)
+    buckets = make_series_buckets(60, seed=7)
+    for i, b in enumerate(buckets):
+        if i == 30:
+            # Freeze the metric set mid-stream (as the first refresh would)
+            # so later appends take the incremental target path while the
+            # first 24 retained rows came from the backfill.
+            st._freeze_metrics()
+        if i == 40:
+            b = Bucket.from_dict(b.to_dict())
+            b.metrics[0] = dataclasses.replace(b.metrics[0],
+                                               component="late-svc")
+            buckets[i] = b          # the recompute below must see it too
+        st.ingest(b)
+    retained = buckets[-24:]
+    # Traffic: recompute every retained row with a fresh space.
+    from deeprest_tpu.config import FeaturizeConfig as _FC
+    from deeprest_tpu.data.featurize import CallPathSpace as _CPS
+
+    fresh = _CPS(config=_FC(hash_features=True, capacity=CAPACITY))
+    expect_traffic = np.stack(
+        [fresh.extract_reference(b.traces) for b in retained])
+    np.testing.assert_array_equal(st.traffic.view(), expect_traffic)
+    # Targets: recompute with the historical per-refresh rebuild semantics.
+    names = st.metric_names
+    pos = {n: i for i, n in enumerate(names)}
+    expect = np.zeros((24, len(names)), np.float32)
+    for t, b in enumerate(retained):
+        for m in b.metrics:
+            i = pos.get(m.key)
+            if i is not None:
+                expect[t, i] = m.value
+    np.testing.assert_array_equal(st._targets(), expect)
+    assert len(st.traffic) == len(st.metrics) == len(st._targets())
+
+
+def test_overlapped_ingest_matches_serial_bit_exact(tmp_path):
+    """The background-ETL path must commit exactly what serial ingestion
+    commits, in the same order (its featurized rows travel through the
+    bounded queue instead of being extracted inline)."""
+    path = str(tmp_path / "raw.jsonl")
+    buckets = make_series_buckets(30, seed=11)
+    save_raw_data_jsonl(buckets, path)
+
+    serial = make_trainer()
+    for b in buckets:
+        serial.ingest(b)
+
+    overlapped = make_trainer(refresh_buckets=10**9)   # never refreshes
+    tailer = BucketTailer(path)
+    done = lambda: overlapped.num_buckets >= len(buckets)
+    results = list(overlapped.run(tailer, should_stop=done, deadline_s=30))
+    tailer.close()
+    assert results == []                               # no refresh fired
+    assert overlapped.num_buckets == serial.num_buckets
+    np.testing.assert_array_equal(overlapped.traffic.view(),
+                                  serial.traffic.view())
+    assert list(overlapped.metrics) == list(serial.metrics)
+    assert overlapped._pending == serial._pending
+
+
+@pytest.mark.slow
+def test_overlapped_refresh_results_match_serial(tmp_path):
+    """Same pre-written corpus, overlap on vs off → identical refresh
+    boundaries and bit-identical losses (poll batches stay atomic through
+    the ETL queue, so readiness lands on the same buckets)."""
+    path = str(tmp_path / "raw.jsonl")
+    save_raw_data_jsonl(make_series_buckets(44, seed=13), path)
+
+    def run_mode(overlap: bool):
+        from deeprest_tpu.config import EtlConfig
+
+        cfg = dataclasses.replace(trainer_config(),
+                                  etl=EtlConfig(overlap=overlap))
+        st = StreamingTrainer(
+            cfg, stream_config(refresh_buckets=12), ckpt_dir=None,
+            feature_config=FeaturizeConfig(hash_features=True,
+                                           capacity=CAPACITY))
+        tailer = BucketTailer(path)
+        out = list(st.run(tailer, max_refreshes=2, deadline_s=120))
+        tailer.close()
+        return st, out
+
+    st_ser, res_ser = run_mode(False)
+    st_ovl, res_ovl = run_mode(True)
+    assert [r.refresh for r in res_ovl] == [r.refresh for r in res_ser]
+    assert [r.num_buckets for r in res_ovl] == [r.num_buckets for r in res_ser]
+    for a, b in zip(res_ovl, res_ser):
+        assert a.train_loss == b.train_loss          # bit-exact, not close
+        assert a.eval_loss == b.eval_loss
+        assert a.etl_dropped == 0 and a.etl_lag_buckets >= 0
+    assert all(r.etl_lag_buckets == 0 for r in res_ser)
+    np.testing.assert_array_equal(st_ovl.traffic.view(),
+                                  st_ser.traffic.view())
+
+
+def test_etl_buffer_backpressure_and_error_propagation():
+    from deeprest_tpu.train.stream import _EtlBuffer
+
+    buf = _EtlBuffer(max_buckets=3)
+    stop = threading.Event()
+    buf.put([1, 2, 3], stop)                  # fills the bucket budget
+    blocked = threading.Event()
+
+    def producer():
+        blocked.set()
+        buf.put([4, 5], stop)                 # budget exhausted: must block
+
+    t = threading.Thread(target=producer)
+    t.start()
+    blocked.wait(5)
+    time.sleep(0.1)
+    assert t.is_alive()                       # backpressure held it
+    assert buf.pending() == 3
+    assert buf.get(timeout=1) == [1, 2, 3]    # drain → producer unblocks
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert buf.get(timeout=1) == [4, 5]
+    buf.fail(RuntimeError("etl died"))
+    with pytest.raises(RuntimeError, match="etl died"):
+        buf.get(timeout=1)
 
 
 # ---------------------------------------------------------------------------
