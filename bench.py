@@ -541,8 +541,39 @@ def main() -> None:
     except Exception as exc:
         print(f"bench: etl measurement failed: {exc}", file=sys.stderr)
 
+    # Rolled-inference headline (schema v5, NEW key): fused device-resident
+    # prediction throughput (windows/s) at the 1-day serving shape on this
+    # host's CPU (benchmarks/infer_bench.py has the full host-loop-vs-fused
+    # sweep).  Runs in a child process — the serving path needs a JAX
+    # backend, and the parent's never-init-a-backend contract holds.
+    rolled_wps = None
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "infer_bench.py"),
+             "--quick", "--headline"],
+            capture_output=True, text=True, timeout=900, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rolled_wps = float(
+                    json.loads(line)["rolled_windows_per_sec"])
+                break
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                continue
+        if rolled_wps is None:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+            print(f"bench: infer headline produced no record: "
+                  f"{' | '.join(tail)}", file=sys.stderr)
+    except Exception as exc:
+        print(f"bench: infer measurement failed: {exc}", file=sys.stderr)
+
     perf = _mfu_block(measured, F)
     result = {
+        # v5: rolled_windows_per_sec is the fused rolled-inference serving
+        # headline — a NEW key, nothing repurposed; every v4 key keeps its
+        # meaning.
         # v4: etl_buckets_per_sec is the host-ETL featurization headline —
         # a NEW key, nothing repurposed; every v3 key keeps its meaning.
         # v3: superstep_steps_per_sec (+ superstep_S) is the fused
@@ -552,7 +583,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 4,
+        "schema_version": 5,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -589,6 +620,8 @@ def main() -> None:
     }
     if etl_bps is not None:
         result["etl_buckets_per_sec"] = round(float(etl_bps), 2)
+    if rolled_wps is not None:
+        result["rolled_windows_per_sec"] = round(rolled_wps, 1)
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

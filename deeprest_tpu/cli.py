@@ -71,6 +71,19 @@ def _require_input(args, features_ok: bool = True):
         sys.exit("error: provide --raw" + (" or --features" if features_ok else ""))
 
 
+def _add_fused_infer_args(p: argparse.ArgumentParser):
+    p.add_argument("--no-fused-infer", action="store_true",
+                   help="serve predictions through the host-loop reference "
+                        "path instead of the fused one-dispatch-per-page "
+                        "device pipeline (serve/fused.py)")
+    p.add_argument("--infer-page-windows", type=int, default=None,
+                   metavar="N",
+                   help="fused-inference page size in windows (adds a rung "
+                        "when off-ladder; default auto: cache-sized small "
+                        "pages on CPU, the ladder's top rung on "
+                        "accelerators)")
+
+
 def _superstep_arg(v: str):
     """``--steps-per-superstep`` parser: int >= 1, 'auto', or 'epoch'."""
     if v in ("auto", "epoch"):
@@ -445,6 +458,60 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def cmd_whatif(args) -> int:
+    """What-if capacity estimation from the command line: a hypothetical
+    traffic mix (optionally swept over a scale grid) → per-metric peak
+    utilization, batched through the fused multi-scenario prediction
+    pipeline (serve/whatif.py estimate_many / sweep)."""
+    from deeprest_tpu.data.synthesize import TraceSynthesizer
+    from deeprest_tpu.serve.predictor import Predictor
+    from deeprest_tpu.serve.whatif import WhatIfEstimator
+
+    pred = Predictor.from_checkpoint(
+        args.ckpt_dir, fused=not args.no_fused_infer,
+        page_windows=args.infer_page_windows)
+    space = pred.space()
+    if space is None:
+        sys.exit("error: checkpoint has no feature space; cannot fit the "
+                 "what-if synthesizer from --raw")
+    synth = TraceSynthesizer(space).fit(_load_buckets(args.raw))
+    est = WhatIfEstimator(pred, synth)
+    try:
+        mix = {str(k): int(v) for k, v in json.loads(args.mix).items()}
+    except (ValueError, AttributeError) as exc:
+        sys.exit(f"error: --mix is not a JSON endpoint→count object: {exc}")
+    unknown = sorted(set(mix) - set(est.endpoints))
+    if unknown:
+        sys.exit(f"error: unknown API endpoints {unknown} "
+                 f"(known: {est.endpoints})")
+    program = [mix] * args.ticks
+    if args.sweep:
+        try:
+            factors = [float(f) for f in args.sweep.split(",")]
+        except ValueError:
+            sys.exit(f"error: --sweep {args.sweep!r} is not a "
+                     "comma-separated list of scale factors")
+        records = est.sweep(program, factors, seed=args.seed)
+        result = {"ticks": args.ticks, "mix": mix, "sweep": records}
+    else:
+        bands = est.estimate(program, seed=args.seed)
+        dm = pred.delta_mask
+        peaks = {
+            metric: {q: (max(float(np.max(s) - s[0]), 0.0)
+                         if dm is not None and dm[e]
+                         else float(np.max(s)))
+                     for q, s in bands[metric].items()}
+            for e, metric in enumerate(pred.metric_names)
+        }
+        result = {"ticks": args.ticks, "mix": mix, "peaks": peaks}
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+        result["out"] = args.out
+    print(json.dumps(result))
+    return 0
+
+
 def cmd_export(args) -> int:
     """Checkpoint → portable inference artifact (serve/export.py)."""
     from deeprest_tpu.serve.export import export_predictor
@@ -503,15 +570,21 @@ def cmd_serve(args) -> int:
             # redundant reload of the step we are about to serve anyway.
             reloader = CheckpointReloader(args.ckpt_dir,
                                           min_interval_s=args.watch,
-                                          ladder=ladder)
-        pred = Predictor.from_checkpoint(args.ckpt_dir, ladder=ladder)
+                                          ladder=ladder,
+                                          fused=not args.no_fused_infer,
+                                          page_windows=args.infer_page_windows)
+        pred = Predictor.from_checkpoint(
+            args.ckpt_dir, ladder=ladder, fused=not args.no_fused_infer,
+            page_windows=args.infer_page_windows)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
             backend += " (watching)"
     else:
         from deeprest_tpu.serve.export import ExportedPredictor
 
-        pred = ExportedPredictor.load(args.artifact, ladder=ladder)
+        pred = ExportedPredictor.load(
+            args.artifact, ladder=ladder, fused=not args.no_fused_infer,
+            page_windows=args.infer_page_windows)
         backend = f"artifact:{args.artifact}"
 
     synthesizer = None
@@ -551,7 +624,10 @@ def _predictor(args):
     from deeprest_tpu.serve.predictor import Predictor
 
     # model architecture comes from the checkpoint sidecar
-    return Predictor.from_checkpoint(args.ckpt_dir)
+    return Predictor.from_checkpoint(
+        args.ckpt_dir,
+        fused=not getattr(args, "no_fused_infer", False),
+        page_windows=getattr(args, "infer_page_windows", None))
 
 
 def _serving_traffic(args, pred) -> np.ndarray:
@@ -829,6 +905,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after this many seconds (0 = no deadline)")
     p.set_defaults(fn=cmd_stream)
 
+    p = sub.add_parser("whatif",
+                       help="hypothetical traffic mix → per-metric peak "
+                            "utilization; --sweep runs a batched capacity-"
+                            "sweep grid through the fused pipeline")
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--raw", required=True,
+                   help="raw corpus to fit the what-if trace synthesizer")
+    p.add_argument("--mix", required=True,
+                   help='JSON {endpoint: count} per time step')
+    p.add_argument("--ticks", type=int, default=60)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sweep", default=None, metavar="F1,F2,...",
+                   help="scale the mix by each factor and estimate ALL "
+                        "scenarios in one batched prediction train "
+                        "(e.g. 0.5,1,2,4)")
+    p.add_argument("--out", default=None,
+                   help="also write the full result JSON here")
+    _add_fused_infer_args(p)
+    p.set_defaults(fn=cmd_whatif)
+
     p = sub.add_parser("export",
                        help="checkpoint → portable inference artifact "
                             "(jax.export StableHLO + JSON manifest)")
@@ -866,12 +962,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated window-count rungs every device "
                         "batch is padded up to (bounds the jit cache to "
                         "one executable per rung)")
+    _add_fused_infer_args(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")
     _add_input_args(p)
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--out", default="predictions.npz")
+    _add_fused_infer_args(p)
     p.set_defaults(fn=cmd_predict)
 
     p = sub.add_parser("anomaly", help="traffic-justified utilization check")

@@ -177,6 +177,31 @@ class EtlConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class InferConfig:
+    """Serving-side rolled-inference knobs (serve/fused.py).
+
+    ``fused=True`` routes ``predict_series`` / ``predict_series_many``
+    through the device-resident one-dispatch-per-page pipeline (on-device
+    normalize → model → clamp, prefix-sum delta integration, carry
+    threaded between pages on device); ``False`` pins the host-loop
+    reference path.  ``page_windows`` sets the fused page size explicitly
+    (an off-ladder value adds one per-rung executable).  ``None`` picks a
+    backend-tuned default: small cache-resident pages on the CPU backend
+    (measured ~2x per-window over rung-32/64 batches — PERF.md "rolled
+    inference"), the ladder's top rung on accelerators (MXU occupancy).
+    """
+
+    fused: bool = True
+    page_windows: int | None = None
+
+    def __post_init__(self):
+        if self.page_windows is not None and self.page_windows < 1:
+            raise ValueError(
+                f"InferConfig.page_windows={self.page_windows}: must be "
+                ">= 1 (or None for the ladder's top rung)")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device-mesh shape for pjit/GSPMD execution.
 
@@ -203,6 +228,7 @@ class Config:
     featurize: FeaturizeConfig = dataclasses.field(default_factory=FeaturizeConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     etl: EtlConfig = dataclasses.field(default_factory=EtlConfig)
+    infer: InferConfig = dataclasses.field(default_factory=InferConfig)
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
@@ -232,6 +258,7 @@ class Config:
             featurize=build(FeaturizeConfig, d.get("featurize", {})),
             mesh=build(MeshConfig, d.get("mesh", {})),
             etl=build(EtlConfig, d.get("etl", {})),
+            infer=build(InferConfig, d.get("infer", {})),
         )
 
     @classmethod

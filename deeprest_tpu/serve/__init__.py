@@ -5,7 +5,10 @@ the HTTP prediction service."""
 from deeprest_tpu.serve.batcher import (
     BatcherConfig, MicroBatcher, ShapeLadder,
 )
-from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
+from deeprest_tpu.serve.fused import FusedRolledEngine
+from deeprest_tpu.serve.predictor import (
+    Predictor, rolled_prediction, rolled_prediction_reference,
+)
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
 from deeprest_tpu.serve.export import ExportedPredictor, export_predictor
@@ -17,8 +20,10 @@ __all__ = [
     "BatcherConfig",
     "MicroBatcher",
     "ShapeLadder",
+    "FusedRolledEngine",
     "Predictor",
     "rolled_prediction",
+    "rolled_prediction_reference",
     "WhatIfEstimator",
     "AnomalyDetector",
     "AnomalyReport",

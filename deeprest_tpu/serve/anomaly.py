@@ -70,6 +70,14 @@ class AnomalyDetector:
         against the model's raw per-bucket increment band — abnormal
         write RATE is the ransomware signal, and a level comparison would
         dilute it with rollout drift accumulated over the whole series.
+
+        ``integrate=False`` rides the fused device pipeline
+        (serve/fused.py): the same per-rung executable serves both the
+        integrated and increment-space requests (the integrate switch is
+        a traced flag, not a recompile), and its raw-increment output is
+        bit-exact with the host reference loop on CPU — so detector
+        thresholds are unchanged by the serving-path migration
+        (tests/test_fused_infer.py pins this).
         """
         dm = getattr(self.predictor, "delta_mask", None)
         preds = self.predictor.predict_series(

@@ -31,7 +31,8 @@ from jax import export as jexport
 
 from deeprest_tpu.data.windows import MinMaxStats
 from deeprest_tpu.serve.batcher import BatchedBackendMixin
-from deeprest_tpu.serve.predictor import Predictor, rolled_prediction
+from deeprest_tpu.serve.fused import FusedInferenceMixin
+from deeprest_tpu.serve.predictor import Predictor
 
 ARTIFACT_BLOB = "model.stablehlo"
 ARTIFACT_MANIFEST = "manifest.json"
@@ -76,7 +77,7 @@ def export_predictor(pred: Predictor, directory: str) -> str:
     return directory
 
 
-class ExportedPredictor(BatchedBackendMixin):
+class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
     """Drop-in serving backend loaded from an artifact directory.
 
     Exposes the same serving protocol as :class:`Predictor`
@@ -90,7 +91,9 @@ class ExportedPredictor(BatchedBackendMixin):
     """
 
     def __init__(self, exported: jexport.Exported, manifest: dict,
-                 ladder: tuple[int, ...] | None = None):
+                 ladder: tuple[int, ...] | None = None,
+                 fused: bool = True,
+                 page_windows: int | None = None):
         if manifest.get("format") != _FORMAT:
             raise ValueError(f"unknown artifact format {manifest.get('format')!r}")
         self._exported = exported
@@ -105,16 +108,31 @@ class ExportedPredictor(BatchedBackendMixin):
         dm = manifest.get("delta_mask")
         self.delta_mask = np.asarray(dm, bool) if dm is not None else None
         self._init_batching(self._exported.call, ladder=ladder)
+        # Exported.call is traceable under jit, so the deserialized
+        # StableHLO module composes into the same fused one-dispatch
+        # pipeline the in-process Predictor uses (serve/fused.py).  The
+        # artifact's weights are baked into the module; params stay ().
+        self._init_fused(lambda _, x: self._exported.call(x),
+                         enabled=fused, page_windows=page_windows)
 
     @classmethod
     def load(cls, directory: str,
-             ladder: tuple[int, ...] | None = None) -> "ExportedPredictor":
+             ladder: tuple[int, ...] | None = None,
+             fused: bool = True,
+             page_windows: int | None = None) -> "ExportedPredictor":
         with open(os.path.join(directory, ARTIFACT_MANIFEST),
                   encoding="utf-8") as f:
             manifest = json.load(f)
         with open(os.path.join(directory, ARTIFACT_BLOB), "rb") as f:
             exported = jexport.deserialize(f.read())
-        return cls(exported, manifest, ladder=ladder)
+        return cls(exported, manifest, ladder=ladder, fused=fused,
+                   page_windows=page_windows)
+
+    def jit_cache_size(self) -> int | None:
+        """Fused-pipeline executable count (the artifact's own symbolic-
+        batch apply has no probe); None when the engine is disabled."""
+        return (self._fused.cache_size()
+                if self._fused is not None else None)
 
     def median_index(self) -> int:
         diffs = [abs(q - 0.5) for q in self.quantiles]
@@ -128,13 +146,7 @@ class ExportedPredictor(BatchedBackendMixin):
 
         return CallPathSpace.from_dict(self.space_dict)
 
-    def predict_series(self, traffic: np.ndarray,
-                       integrate: bool = True) -> np.ndarray:
-        """[T, F] raw traffic → de-normalized [T, E, Q] predictions, same
-        tiling/integration/shape-ladder semantics as the in-process
-        Predictor (windows route through ``apply_windows``)."""
-        return rolled_prediction(
-            self.apply_windows, self.x_stats, self.y_stats,
-            self.window_size, traffic,
-            delta_mask=self.delta_mask if integrate else None,
-            median_index=self.median_index())
+    # predict_series / predict_series_many come from FusedInferenceMixin —
+    # identical tiling/integration/routing semantics to the in-process
+    # Predictor (fused device pipeline by default, shape-laddered
+    # rolled_prediction_reference through ``apply_windows`` otherwise).

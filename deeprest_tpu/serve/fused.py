@@ -1,0 +1,393 @@
+"""Device-resident rolled inference: one dispatch per window page.
+
+``rolled_prediction_reference`` (serve/predictor.py) is a host-bound loop:
+it stacks windows in numpy, normalizes on host, reads every ``[n, W, E, Q]``
+batch back, de-normalizes on host, and integrates delta-trained metrics
+with a *sequential* per-window Python carry loop.  That was the last pure-
+Python hot path between a traffic series and a prediction after the
+micro-batched server (PR 1), superstep training (PR 2) and vectorized ETL
+(PR 3) — and it caps month-scale and multi-scenario what-if throughput.
+
+:class:`FusedRolledEngine` fuses the whole pipeline into a single
+jit-compiled device program per page:
+
+- windows are tiled on host as zero-copy-adjacent slices and shipped raw
+  (un-normalized) once per page;
+- ``x_stats`` normalization, the model forward pass, and the ``1e-6``
+  clamp run on device;
+- delta-mask columns are de-normalized on device and integrated with a
+  PARALLEL prefix sum: per-window ``jnp.cumsum`` over the window axis,
+  then an exclusive cumsum of per-window carry increments over the window
+  (batch) axis replaces the sequential cross-window median carry.  Ragged
+  right-aligned last windows and multi-scenario folds are expressed
+  uniformly via per-window *carry offsets* (``g``: which in-window step
+  the NEXT window's carry reads) and *segment starts* (``seg``: windows
+  where the carry resets to zero — a new series/scenario);
+- long series page through a fixed-size executable (one per ShapeLadder
+  rung — zero new executables beyond the rung set) with the carry
+  threaded between pages as a device-resident ``[E]`` array, never read
+  back to host.
+
+Numerics contract (pinned by tests/test_fused_infer.py):
+
+- Non-delta metrics are BIT-EXACT vs the host reference on CPU.  XLA CPU
+  contracts ``p * range + min`` into a single-rounding FMA inside fusions
+  (1-ulp drift vs numpy's two-rounding, and neither ``optimization_barrier``
+  nor ``xla_allow_excess_precision`` prevents it), so the fused program
+  returns non-delta columns NORMALIZED and the host applies the exact
+  reference ``y_stats.invert`` after readback — bit-exact by construction.
+  Normalization stats enter the program as runtime arguments, not baked
+  constants: a baked constant range lets XLA strength-reduce the divide
+  into a multiply-by-reciprocal, which also breaks bit parity.
+- Delta-mask columns carry a documented <= 1e-5 relative tolerance: the
+  on-device invert may contract to FMA and the prefix-sum carry
+  re-associates the reference's left-to-right float32 adds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+DEFAULT_FUSED_RUNGS = (8, 16, 32, 64)
+
+
+def plan_windows(lengths: list[int], window_size: int):
+    """Global window plan over a list of series lengths.
+
+    Returns ``[(series_idx, start, carry_offset, seg_start), ...]`` in
+    dispatch order.  ``carry_offset`` is the in-window step index the NEXT
+    window's carry reads from this window's integrated median (``W - 1``
+    for regular tiling; ``t - W - 1 - start`` when the next window is the
+    ragged right-aligned tail).  ``seg_start`` marks the first window of
+    each series: the integration carry resets to zero there (what-if
+    rollouts are relative-from-zero per scenario).
+    """
+    w = window_size
+    metas: list[tuple[int, int, int, bool]] = []
+    for si, t in enumerate(lengths):
+        if t < w:
+            raise ValueError(f"series length {t} < window_size {w}")
+        starts = list(range(0, t - w + 1, w))
+        if starts[-1] != t - w:
+            starts.append(t - w)
+        for j, s in enumerate(starts):
+            if j + 1 < len(starts):
+                g = starts[j + 1] - 1 - s
+            else:
+                g = w - 1          # last window of the series: carry unused
+            metas.append((si, s, g, j == 0))
+    return metas
+
+
+class FusedRolledEngine:
+    """One-dispatch-per-page rolled prediction over a batched apply.
+
+    ``apply_fn(params, x)`` must be traceable under ``jax.jit`` and map
+    normalized ``[n, W, F]`` windows to ``[n, W, E, Q]`` predictions (the
+    in-process model apply, or ``jax.export``'s ``Exported.call`` with
+    ``params = ()``).  ``params`` is threaded through the jit as a runtime
+    ARGUMENT, never a closure: baked-constant weights let XLA constant-fold
+    parameter subgraphs (e.g. the soft feature mask) with its compile-time
+    evaluator, whose rounding differs ~1 ulp from the runtime kernels —
+    which would break bit parity with the ladder path's standalone apply.
+    """
+
+    def __init__(self, apply_fn, x_stats, y_stats, window_size: int,
+                 params=(),
+                 delta_mask: np.ndarray | None = None,
+                 median_index: int | None = None,
+                 rungs=DEFAULT_FUSED_RUNGS,
+                 page_windows: int | None = None):
+        import jax
+
+        rung_set = {int(r) for r in rungs}
+        if page_windows is not None:
+            if page_windows < 1:
+                raise ValueError(f"page_windows {page_windows} must be >= 1")
+            rung_set.add(int(page_windows))
+        self.rungs = tuple(sorted(rung_set))
+        if not self.rungs or self.rungs[0] < 1:
+            raise ValueError(f"bad fused rung set {rungs!r}")
+        if page_windows is not None:
+            self.page = int(page_windows)
+        elif jax.default_backend() == "cpu":
+            # Measured on XLA CPU (PERF.md "rolled inference"): GRU
+            # per-window cost is MINIMIZED at small batch — the recurrence
+            # state stays cache-resident — and grows ~2x by rung 32/64.
+            # Page at the smallest rung >= 8 so pages stay in cache;
+            # larger rungs still serve explicit overrides.
+            at_least_8 = [r for r in self.rungs if r >= 8]
+            self.page = at_least_8[0] if at_least_8 else self.rungs[-1]
+        else:
+            # Accelerators want the widest batch the ladder offers (MXU
+            # row occupancy; the CPU cache argument does not apply).
+            self.page = self.rungs[-1]
+        self._apply_fn = apply_fn
+        self._params = params
+        self.window_size = int(window_size)
+        self.x_stats = x_stats
+        self.y_stats = y_stats
+        dm = (np.asarray(delta_mask, bool)
+              if delta_mask is not None else None)
+        self._has_delta = dm is not None and bool(dm.any())
+        if self._has_delta and median_index is None:
+            raise ValueError("delta_mask requires median_index for the "
+                             "cross-window carry")
+        self._delta = dm
+        self._median = int(median_index) if median_index is not None else 0
+        # Stats staged on device ONCE as runtime arguments (see module
+        # docstring: baked constants break bit parity via strength
+        # reduction).  x stats broadcast over the feature axis, y stats
+        # over the metric axis of [R, W, E, Q].
+        import jax.numpy as jnp
+
+        self._x_mn = jnp.asarray(np.asarray(x_stats.min, np.float32).reshape(-1))
+        self._x_rg = jnp.asarray(np.asarray(x_stats.range, np.float32).reshape(-1))
+        y_mn = np.asarray(y_stats.min, np.float32).reshape(-1)
+        y_rg = np.asarray(y_stats.range, np.float32).reshape(-1)
+        self._y_mn = jnp.asarray(y_mn.reshape(1, 1, -1, 1))
+        self._y_rg = jnp.asarray(y_rg.reshape(1, 1, -1, 1))
+        n_carry = len(self._delta) if self._has_delta else 1
+        self._carry0 = jnp.zeros((n_carry,), jnp.float32)
+        if self._has_delta:
+            self._delta_dev = jnp.asarray(self._delta)[None, None, :, None]
+        self._jit = jax.jit(self._program)
+        self._lock = threading.Lock()
+        self._pages = 0
+        self._windows = 0
+        self._padded_windows = 0
+        self._series = 0
+        self._compiled: set[int] = set()
+
+    # -- device program -------------------------------------------------
+
+    def _program(self, params, x, x_mn, x_rg, y_mn, y_rg, carry_in, g, seg,
+                 n_valid, integrate):
+        """``[R, W, F]`` raw windows -> (``[R, W, E, Q]``, carry ``[E]``).
+
+        Output columns: delta metrics (when ``integrate``) de-normalized
+        and integrated on device; everything else clamped NORMALIZED
+        predictions (the host applies the reference invert — see module
+        docstring).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        r = x.shape[0]
+        # mirror MinMaxStats.apply exactly (degenerate ranges pass through)
+        xn = jnp.where(x_rg == 0.0, x,
+                       (x - x_mn) / jnp.where(x_rg == 0.0, 1.0, x_rg))
+        preds = self._apply_fn(params, xn)                 # [R, W, E, Q]
+        preds = jnp.maximum(preds, 1e-6)
+        if not self._has_delta:
+            return preds, carry_in
+
+        # De-normalize ON DEVICE for the integration arithmetic only (the
+        # delta tolerance absorbs the FMA contraction); mirror
+        # MinMaxStats.invert including the degenerate-range guard.
+        denorm = jnp.where(y_rg == 0.0, preds, preds * y_rg + y_mn)
+        csum = jnp.cumsum(denorm, axis=1)                  # [R, W, E, Q]
+        med = csum[..., self._median]                      # [R, W, E]
+        # per-window carry increment: the integrated median value the NEXT
+        # window's base reads (full-window total for regular tiling, the
+        # mid-window value feeding a ragged right-aligned tail)
+        totals = jnp.take_along_axis(med, g[:, None, None], axis=1)[:, 0, :]
+        valid = jnp.arange(r)[:, None] < n_valid
+        totals = jnp.where(valid, totals, 0.0)             # [R, E]
+        # segmented EXCLUSIVE prefix sum over the window axis: base_k is
+        # the carry accumulated since the segment start (series/scenario
+        # boundary), or carry_in + prefix for the page-continuing segment
+        excl = jnp.cumsum(totals, axis=0) - totals
+        idx = jnp.arange(r)
+        start_pos = jax.lax.cummax(jnp.where(seg, idx, -1))
+        seg_base = jnp.take(excl, jnp.clip(start_pos, 0, r - 1), axis=0)
+        base = jnp.where(start_pos[:, None] >= 0,
+                         excl - seg_base, excl + carry_in[None, :])
+        last = jnp.clip(n_valid - 1, 0, r - 1)
+        carry_out = (jnp.take(base, last, axis=0)
+                     + jnp.take(totals, last, axis=0))
+        integrated = base[:, None, :, None] + csum
+        out = jnp.where(jnp.logical_and(self._delta_dev, integrate),
+                        integrated, preds)
+        return out, carry_out
+
+    # -- host paging ----------------------------------------------------
+
+    @property
+    def page_windows(self) -> int:
+        return self.page
+
+    def rung_for(self, n: int) -> int:
+        for r in self.rungs:
+            if n <= r:
+                return r
+        raise ValueError(f"page of {n} windows exceeds top rung "
+                         f"{self.rungs[-1]}; chunk before dispatching")
+
+    def predict_many(self, series_list, integrate: bool = True):
+        """Raw ``[T_i, F]`` series -> de-normalized ``[T_i, E, Q]`` each.
+
+        All series fold into one window stream (segment resets at series
+        boundaries), paged through the fused executable with the carry
+        chained between pages on device.  ``integrate=False`` leaves
+        delta-trained columns as raw per-bucket increments, matching
+        ``rolled_prediction_reference(delta_mask=None)`` bit-exactly.
+        """
+        import jax.numpy as jnp
+
+        w = self.window_size
+        arrays = [np.ascontiguousarray(s, dtype=np.float32)
+                  for s in series_list]
+        if not arrays:
+            return []
+        feat = arrays[0].shape[1]
+        metas = plan_windows([len(a) for a in arrays], w)
+        page = self.page
+        carry = self._carry0
+        dispatched = []
+        pages = padded = 0
+        for lo in range(0, len(metas), page):
+            chunk = metas[lo:lo + page]
+            rung = self.rung_for(len(chunk))
+            x = np.zeros((rung, w, feat), np.float32)
+            g = np.full((rung,), w - 1, np.int32)
+            seg = np.zeros((rung,), np.bool_)
+            for row, (si, s, gg, is_first) in enumerate(chunk):
+                x[row] = arrays[si][s:s + w]
+                g[row] = gg
+                seg[row] = is_first
+            out, carry = self._jit(
+                self._params, jnp.asarray(x), self._x_mn, self._x_rg,
+                self._y_mn, self._y_rg, carry, jnp.asarray(g),
+                jnp.asarray(seg), np.int32(len(chunk)),
+                np.bool_(integrate))
+            dispatched.append((out, chunk))
+            pages += 1
+            padded += rung - len(chunk)
+        with self._lock:
+            self._pages += pages
+            self._windows += len(metas)
+            self._padded_windows += padded
+            self._series += len(arrays)
+            self._compiled.update(self.rung_for(len(c)) for _, c in dispatched)
+
+        out_dims = None
+        use_device_delta = self._has_delta and integrate
+        outs: list[np.ndarray | None] = [None] * len(arrays)
+        for out_dev, chunk in dispatched:
+            arr = np.asarray(out_dev)                      # [R, W, E, Q]
+            # host-side invert, in the reference's exact op order/layout,
+            # for the columns the device left normalized (bit parity)
+            inv = self.y_stats.invert(
+                arr.transpose(0, 1, 3, 2)).transpose(0, 1, 3, 2)
+            if use_device_delta:
+                arr = np.where(self._delta[None, None, :, None], arr, inv)
+            else:
+                arr = inv
+            if out_dims is None:
+                out_dims = arr.shape[2:]                   # (E, Q)
+                for si, a in enumerate(arrays):
+                    outs[si] = np.empty((len(a), *out_dims), np.float32)
+            for row, (si, s, _, _) in enumerate(chunk):
+                outs[si][s:s + w] = arr[row]   # later (ragged) window wins
+        return outs
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rungs": list(self.rungs),
+                "page_windows": self.page,
+                "pages": self._pages,
+                "windows": self._windows,
+                "padded_windows": self._padded_windows,
+                "series": self._series,
+                "dispatched_rungs": sorted(self._compiled),
+            }
+
+    def cache_size(self) -> int | None:
+        """Compiled-executable count of the fused program (None when the
+        running jax version has no cache probe)."""
+        probe = getattr(self._jit, "_cache_size", None)
+        return int(probe()) if callable(probe) else None
+
+
+class FusedInferenceMixin:
+    """Shared by Predictor and ExportedPredictor: the fused device-resident
+    ``predict_series`` / ``predict_series_many`` entry points, layered over
+    the shape-laddered host path (serve/batcher.BatchedBackendMixin).
+
+    Routing: the fused engine serves every series when no cross-request
+    MicroBatcher is attached.  With a batcher attached, series that fit a
+    single ladder dispatch keep routing through it (coalescing tiny
+    concurrent requests is the batcher's win), while longer series — which
+    would monopolize coalesced batches anyway — take the fused path.
+    """
+
+    _fused: FusedRolledEngine | None = None
+
+    def _init_fused(self, apply_fn, params=(), enabled: bool = True,
+                    page_windows: int | None = None) -> None:
+        if not enabled:
+            self._fused = None
+            return
+        self._fused = FusedRolledEngine(
+            apply_fn, self.x_stats, self.y_stats, self.window_size,
+            params=params,
+            delta_mask=self.delta_mask, median_index=self.median_index(),
+            rungs=self.ladder.ladder, page_windows=page_windows)
+
+    @property
+    def fused(self) -> FusedRolledEngine | None:
+        return self._fused
+
+    def _num_windows(self, t: int) -> int:
+        w = self.window_size
+        n = (t - w) // w + 1
+        return n + (1 if (t - w) % w != 0 else 0)
+
+    def _route_fused(self, t: int) -> bool:
+        if self._fused is None:
+            return False
+        if getattr(self, "_batcher", None) is None:
+            return True
+        return self._num_windows(t) > self.ladder.max_rung
+
+    def predict_series(self, traffic: np.ndarray,
+                       integrate: bool = True) -> np.ndarray:
+        """[T, F] raw traffic -> de-normalized [T, E, Q] predictions.
+
+        Fused device path by default (see :class:`FusedRolledEngine`);
+        falls back to the pinned host loop
+        (:func:`~deeprest_tpu.serve.predictor.rolled_prediction_reference`)
+        through ``apply_windows`` when the engine is disabled or when a
+        MicroBatcher should coalesce this request (see class docstring).
+        ``integrate=False`` leaves delta-trained columns as raw per-bucket
+        increments — the sharper domain for anomaly detection.
+        """
+        traffic = np.asarray(traffic)
+        if self._route_fused(len(traffic)):
+            return self._fused.predict_many([traffic], integrate=integrate)[0]
+        from deeprest_tpu.serve.predictor import rolled_prediction_reference
+
+        return rolled_prediction_reference(
+            self.apply_windows, self.x_stats, self.y_stats,
+            self.window_size, traffic,
+            delta_mask=self.delta_mask if integrate else None,
+            median_index=self.median_index())
+
+    def predict_series_many(self, series_list,
+                            integrate: bool = True) -> list[np.ndarray]:
+        """Batched multi-series entry: S raw ``[T_i, F]`` series fold into
+        the scenario×window batch axis of the fused engine (shared pages,
+        per-series carry resets) — the backbone of
+        ``WhatIfEstimator.estimate_many`` and capacity sweeps.  Falls back
+        to per-series prediction when the fused engine is disabled."""
+        if self._fused is not None:
+            return self._fused.predict_many(list(series_list),
+                                            integrate=integrate)
+        return [self.predict_series(s, integrate=integrate)
+                for s in series_list]

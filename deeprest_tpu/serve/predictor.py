@@ -17,14 +17,25 @@ from deeprest_tpu.config import Config, ModelConfig
 from deeprest_tpu.data.windows import MinMaxStats
 from deeprest_tpu.models.qrnn import QuantileGRU
 from deeprest_tpu.serve.batcher import BatchedBackendMixin
+from deeprest_tpu.serve.fused import FusedInferenceMixin
 
 
-def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
-                      window_size: int, traffic: np.ndarray,
-                      max_batch: int = 64,
-                      delta_mask: np.ndarray | None = None,
-                      median_index: int | None = None) -> np.ndarray:
+def rolled_prediction_reference(
+        apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
+        window_size: int, traffic: np.ndarray,
+        max_batch: int = 64,
+        delta_mask: np.ndarray | None = None,
+        median_index: int | None = None) -> np.ndarray:
     """[T, F] raw traffic → de-normalized [T, E, Q] predictions.
+
+    The HOST-LOOP reference implementation: windows stacked and
+    normalized in numpy, every batch read back, de-normalized on host,
+    delta columns integrated with a sequential per-window carry.  The
+    production path is the fused device program (serve/fused.py) — this
+    loop is kept as the pinned numerical specification
+    (tests/test_fused_infer.py: the fused path must match it bit-exactly
+    on CPU for non-delta metrics, <= 1e-5 relative for the prefix-sum
+    delta carry).
 
     The series is tiled into non-overlapping windows (last window
     right-aligned so every step is covered exactly once; the recurrent
@@ -88,7 +99,11 @@ def rolled_prediction(apply_fn, x_stats: MinMaxStats, y_stats: MinMaxStats,
     return out
 
 
-class Predictor(BatchedBackendMixin):
+# Historical name, kept for consumers pinned to the host loop.
+rolled_prediction = rolled_prediction_reference
+
+
+class Predictor(BatchedBackendMixin, FusedInferenceMixin):
     """Quantile predictions for traffic feature series."""
 
     def __init__(self, params, model_config: ModelConfig,
@@ -96,7 +111,9 @@ class Predictor(BatchedBackendMixin):
                  metric_names: list[str], window_size: int,
                  space_dict: dict | None = None,
                  delta_mask: np.ndarray | None = None,
-                 ladder: tuple[int, ...] | None = None):
+                 ladder: tuple[int, ...] | None = None,
+                 fused: bool = True,
+                 page_windows: int | None = None):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -120,13 +137,41 @@ class Predictor(BatchedBackendMixin):
         self._init_batching(
             lambda x: self._apply(self.params, jnp.asarray(x)),
             ladder=ladder)
+        # The fused device-resident rolled-inference engine (serve/fused.py)
+        # shares the ladder's rung set, so mixed series lengths compile at
+        # most one fused executable per rung.  Params thread through the
+        # fused jit as arguments (bit parity — see FusedRolledEngine).
+        self._init_fused(
+            lambda p, x: self._apply(p, x), params=self.params,
+            enabled=fused, page_windows=page_windows)
 
     def jit_cache_size(self) -> int | None:
-        """Compiled-executable count of the serving apply (None when the
-        running jax version has no cache probe) — the test hook behind the
-        'mixed series lengths trigger zero new compiles' guarantee."""
+        """Total compiled-executable count across BOTH serving programs —
+        the per-rung batched apply and the fused rolled-inference pipeline
+        (None when the running jax version has no cache probe) — the test
+        hook behind the 'mixed series lengths trigger zero new compiles'
+        guarantee.  ``jit_cache_stats`` has the per-program breakdown."""
+        sizes = []
         probe = getattr(self._apply, "_cache_size", None)
-        return int(probe()) if callable(probe) else None
+        if callable(probe):
+            sizes.append(int(probe()))
+        if self._fused is not None:
+            fused = self._fused.cache_size()
+            if fused is not None:
+                sizes.append(fused)
+        return sum(sizes) if sizes else None
+
+    def jit_cache_stats(self) -> dict:
+        """Per-program executable counts plus the rung sets bounding them."""
+        probe = getattr(self._apply, "_cache_size", None)
+        return {
+            "apply": int(probe()) if callable(probe) else None,
+            "fused": (self._fused.cache_size()
+                      if self._fused is not None else None),
+            "ladder_rungs": len(self.ladder.ladder),
+            "fused_rungs": (len(self._fused.rungs)
+                            if self._fused is not None else 0),
+        }
 
     @property
     def model_config(self) -> ModelConfig:
@@ -154,7 +199,9 @@ class Predictor(BatchedBackendMixin):
     @classmethod
     def from_checkpoint(cls, directory: str, config: Config | None = None,
                         step: int | None = None,
-                        ladder: tuple[int, ...] | None = None) -> "Predictor":
+                        ladder: tuple[int, ...] | None = None,
+                        fused: bool = True,
+                        page_windows: int | None = None) -> "Predictor":
         """Restore params + host stats written by Trainer.save().
 
         With ``config=None`` the architecture comes wholesale from the
@@ -200,6 +247,8 @@ class Predictor(BatchedBackendMixin):
             space_dict=extra.get("space"),
             delta_mask=extra.get("delta_mask"),
             ladder=ladder,
+            fused=fused,
+            page_windows=page_windows,
         )
 
     def space(self):
@@ -212,21 +261,8 @@ class Predictor(BatchedBackendMixin):
         return CallPathSpace.from_dict(self.space_dict)
 
     # ------------------------------------------------------------------
-
-    def predict_series(self, traffic: np.ndarray,
-                       integrate: bool = True) -> np.ndarray:
-        """[T, F] raw traffic features → de-normalized [T, E, Q] predictions
-        (see :func:`rolled_prediction` for the tiling semantics; delta-
-        trained metrics come back integrated to a relative level series).
-        ``integrate=False`` leaves delta-trained columns as raw per-bucket
-        increments — the sharper domain for anomaly detection (abnormal
-        write RATE, no rollout drift).
-
-        Windows route through :meth:`apply_windows` — the shape-laddered
-        batch entry point, coalesced across concurrent requests when a
-        MicroBatcher is attached (serve/batcher.py)."""
-        return rolled_prediction(
-            self.apply_windows,
-            self.x_stats, self.y_stats, self.window_size, traffic,
-            delta_mask=self.delta_mask if integrate else None,
-            median_index=self.median_index())
+    # predict_series / predict_series_many come from FusedInferenceMixin:
+    # the fused one-dispatch-per-page device pipeline by default, falling
+    # back to rolled_prediction_reference through apply_windows (the
+    # shape-laddered, MicroBatcher-coalesced host path) — see
+    # serve/fused.py for the routing rule and numerics contract.

@@ -59,12 +59,15 @@ class CheckpointReloader:
     """
 
     def __init__(self, ckpt_dir: str, min_interval_s: float = 2.0,
-                 ladder: tuple[int, ...] | None = None):
+                 ladder: tuple[int, ...] | None = None,
+                 fused: bool = True, page_windows: int | None = None):
         from deeprest_tpu.train.checkpoint import latest_step
 
         self.ckpt_dir = ckpt_dir
         self.min_interval_s = min_interval_s
         self.ladder = ladder      # reloaded predictors keep the serving ladder
+        self.fused = fused        # ... and the fused-inference config
+        self.page_windows = page_windows
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
         self._pending = None       # loaded Predictor awaiting pickup
@@ -108,7 +111,9 @@ class CheckpointReloader:
         fresh = None
         try:
             fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step,
-                                              ladder=self.ladder)
+                                              ladder=self.ladder,
+                                              fused=self.fused,
+                                              page_windows=self.page_windows)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/
             # ValueError); anything else is logged but must never wedge
@@ -224,6 +229,12 @@ class PredictionService:
         elif getattr(self.predictor, "ladder", None) is not None:
             out["batcher"] = None
             out["shape_ladder"] = self.predictor.ladder.stats()
+        fused = getattr(self.predictor, "fused", None)
+        if fused is not None:
+            # page/dispatch counters of the fused rolled-inference engine
+            # (additive key; the wire protocol's existing fields are
+            # untouched)
+            out["fused_infer"] = fused.stats()
         return out
 
     def meta(self) -> dict:

@@ -5,6 +5,16 @@ much resource would each component need if traffic looked like X?" for X
 with shapes/scales/compositions never observed.  Pipeline: per-endpoint
 trace synthesis (data/synthesize.py) → feature series → quantile
 predictions per component×resource.
+
+Multi-scenario estimation (:meth:`WhatIfEstimator.estimate_many`, the
+capacity sweep :meth:`WhatIfEstimator.sweep`, and
+:meth:`WhatIfEstimator.scaling_factor`) batches S hypothetical traffic
+programs through the predictor's fused device pipeline
+(``predict_series_many``, serve/fused.py): all scenarios fold into the
+scenario×window batch axis and page through the same per-rung fused
+executables — S scenarios cost ~⌈ΣS windows / page⌉ device dispatches
+instead of S sequential host-loop prediction trains, and compile nothing
+new.
 """
 
 from __future__ import annotations
@@ -35,6 +45,17 @@ class WhatIfEstimator:
         dm = self.predictor.delta_mask
         return dm is not None and bool(dm[e])
 
+    def _bands(self, preds: np.ndarray) -> dict[str, dict[str, np.ndarray]]:
+        """[T, E, Q] predictions → {metric: {"qNN": [T] series}}."""
+        quantiles = self.predictor.quantiles
+        return {
+            metric: {
+                f"q{int(q * 100):02d}": preds[:, e, qi]
+                for qi, q in enumerate(quantiles)
+            }
+            for e, metric in enumerate(self.predictor.metric_names)
+        }
+
     def estimate(
         self,
         expected_traffic: list[dict[str, int]],
@@ -50,15 +71,77 @@ class WhatIfEstimator:
         re-anchors exactly these series before display
         (web-demo/dataloader.py:143-156).
         """
-        x = self.synthesizer.synthesize_series(expected_traffic, seed=seed)
-        preds = self.predictor.predict_series(x)          # [T, E, Q]
-        quantiles = self.predictor.quantiles
-        out: dict[str, dict[str, np.ndarray]] = {}
-        for e, metric in enumerate(self.predictor.metric_names):
-            out[metric] = {
-                f"q{int(q * 100):02d}": preds[:, e, qi]
-                for qi, q in enumerate(quantiles)
-            }
+        return self.estimate_many([expected_traffic], seeds=[seed])[0]
+
+    def estimate_many(
+        self,
+        traffic_programs: list[list[dict[str, int]]],
+        seed: int = 0,
+        seeds: list[int] | None = None,
+    ) -> list[dict[str, dict[str, np.ndarray]]]:
+        """Batched multi-scenario estimation: S traffic programs (of
+        possibly different lengths) → S per-metric band dicts, one
+        prediction train.
+
+        All scenarios synthesize on host, then fold into the predictor's
+        fused scenario×window batch axis (``predict_series_many``): the
+        delta-integration carry resets per scenario, pages are shared
+        across scenarios, and no new executables compile for any S.
+        ``seeds`` pins each scenario's synthesis seed (defaults to
+        ``seed + i`` — scenario i of a sweep is reproducible regardless
+        of batch composition).
+        """
+        if seeds is None:
+            seeds = [seed + i for i in range(len(traffic_programs))]
+        if len(seeds) != len(traffic_programs):
+            raise ValueError(
+                f"{len(seeds)} seeds for {len(traffic_programs)} programs")
+        series = [
+            self.synthesizer.synthesize_series(program, seed=s)
+            for program, s in zip(traffic_programs, seeds)
+        ]
+        many = getattr(self.predictor, "predict_series_many", None)
+        if many is not None:
+            preds = many(series)
+        else:
+            preds = [self.predictor.predict_series(x) for x in series]
+        return [self._bands(p) for p in preds]
+
+    def sweep(
+        self,
+        base_traffic: list[dict[str, int]],
+        factors: list[float],
+        seed: int = 0,
+    ) -> list[dict]:
+        """Capacity-sweep grid: scale ``base_traffic`` by each factor and
+        estimate all scaled programs in ONE batched prediction train.
+
+        Returns one record per factor:
+        ``{"factor": f, "peaks": {metric: {"qNN": peak}}}``
+        where delta-trained (relative) metrics report peak GROWTH over the
+        program (peak minus start — the demo's post-re-anchor semantics)
+        and absolute metrics report the plain peak.
+        """
+        if not factors:
+            raise ValueError("sweep requires at least one factor")
+        programs = [
+            [{ep: int(round(n * f)) for ep, n in step.items()}
+             for step in base_traffic]
+            for f in factors
+        ]
+        results = self.estimate_many(programs, seed=seed)
+        out = []
+        for f, bands in zip(factors, results):
+            peaks: dict[str, dict[str, float]] = {}
+            for e, metric in enumerate(self.predictor.metric_names):
+                per_q = {}
+                for q, series in bands[metric].items():
+                    if self._is_relative(e):
+                        per_q[q] = max(float(np.max(series) - series[0]), 0.0)
+                    else:
+                        per_q[q] = float(np.max(series))
+                peaks[metric] = per_q
+            out.append({"factor": float(f), "peaks": peaks})
         return out
 
     def scaling_factor(
@@ -74,20 +157,17 @@ class WhatIfEstimator:
         the reference demo's own post-re-anchor semantics; a peak ratio on
         a relative-from-zero rollout would be meaningless.
 
-        With a MicroBatcher attached to the predictor the two programs
-        are estimated CONCURRENTLY, so their windows coalesce into shared
-        device batches instead of two sequential dispatch trains."""
-        if getattr(self.predictor, "batcher", None) is not None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=2) as pool:
-                fb = pool.submit(self.estimate, baseline_traffic, seed)
-                fh = pool.submit(self.estimate, hypothetical_traffic,
-                                 seed + 1)
-                base, hypo = fb.result(), fh.result()
-        else:
-            base = self.estimate(baseline_traffic, seed=seed)
-            hypo = self.estimate(hypothetical_traffic, seed=seed + 1)
+        Both programs fold into one batched prediction train through
+        ``estimate_many`` (shared fused pages — this replaced the earlier
+        two-thread MicroBatcher workaround).  Degenerate peaks follow one
+        convention for BOTH metric kinds: zero baseline and zero
+        hypothetical means "no change" (1.0); zero baseline with real
+        hypothetical load is unbounded (inf) — previously absolute metrics
+        leaked inf into bar charts even when both peaks were zero.
+        """
+        base, hypo = self.estimate_many(
+            [baseline_traffic, hypothetical_traffic],
+            seeds=[seed, seed + 1])
         factors = {}
         for e, metric in enumerate(self.predictor.metric_names):
             bs, hs = base[metric]["q50"], hypo[metric]["q50"]
@@ -102,5 +182,6 @@ class WhatIfEstimator:
             else:
                 b = float(np.max(bs))
                 h = float(np.max(hs))
-                factors[metric] = h / b if b > 0 else float("inf")
+                factors[metric] = (h / b if b > 0
+                                   else (1.0 if h <= 0 else float("inf")))
         return factors

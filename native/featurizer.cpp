@@ -33,6 +33,19 @@
 #include <unordered_map>
 #include <vector>
 
+// Feature probe: libstdc++ ships integer std::from_chars from gcc 8 but
+// the floating-point overloads only from gcc 11 (__cpp_lib_to_chars is
+// defined exactly when they exist).  On older toolchains (this image's
+// gcc 10) fall back to strtod pinned to the "C" locale via newlocale —
+// strtod_l is locale-explicit, so the fallback keeps the parser
+// independent of whatever LC_NUMERIC the host process that dlopen'ed
+// this library has set (the reason from_chars was chosen originally).
+#if !defined(__cpp_lib_to_chars)
+#include <cerrno>
+#include <cstdlib>
+#include <locale.h>
+#endif
+
 namespace {
 
 // ---------------------------------------------------------------- errors
@@ -268,6 +281,7 @@ class Parser {
                              *p_ == 'e' || *p_ == 'E'))
             ++p_;
         if (p_ == start) fail("expected number");
+#if defined(__cpp_lib_to_chars)
         // from_chars is locale-independent (std::stod honors LC_NUMERIC set
         // by whatever host process dlopen'ed this library).
         double v = 0.0;
@@ -285,6 +299,29 @@ class Parser {
         if (res.ec != std::errc() || res.ptr != p_)
             fail("bad number '" + std::string(start, p_) + "'");
         return v;
+#else
+        // gcc-10 fallback: strtod_l against a process-wide "C" locale.
+        // strtod needs a NUL-terminated buffer; the token is bounded, so
+        // copy it (numbers are a few dozen bytes at most in this schema).
+        static locale_t c_locale = newlocale(LC_ALL_MASK, "C", nullptr);
+        std::string text(start, p_);
+        if (text.size() > 512) fail("number token too long");
+        char* tend = nullptr;
+        errno = 0;
+        double v = strtod_l(text.c_str(), &tend, c_locale);
+        if (tend != text.c_str() + text.size())
+            fail("bad number '" + text + "'");
+        if (errno == ERANGE) {
+            // Overflow already saturated to +/-HUGE_VAL (Python parity);
+            // underflow: match the from_chars branch above and flush to
+            // signed zero.
+            if (std::abs(v) <= 1.0) {
+                bool neg = text[0] == '-';
+                return neg ? -0.0 : 0.0;
+            }
+        }
+        return v;
+#endif
     }
 
     void skip_value() {

@@ -157,6 +157,34 @@ def test_featurize_out_without_extension(tmp_path):
 
 
 @pytest.mark.slow
+def test_whatif_command_and_sweep(pipeline, capsys, tmp_path):
+    """`whatif` estimates a hypothetical mix; `--sweep` runs the batched
+    capacity grid through the fused multi-scenario pipeline."""
+    compose = "nginx-thrift_/wrk2-api/post/compose"
+    mix = json.dumps({compose: 10})
+    assert main(["whatif", f"--ckpt-dir={pipeline['ckpt']}",
+                 f"--raw={pipeline['raw']}", f"--mix={mix}",
+                 "--ticks=24"]) == 0
+    info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert info["ticks"] == 24
+    assert all(set(q) == {"q05", "q50", "q95"}
+               for q in info["peaks"].values())
+
+    out = str(tmp_path / "sweep.json")
+    assert main(["whatif", f"--ckpt-dir={pipeline['ckpt']}",
+                 f"--raw={pipeline['raw']}", f"--mix={mix}",
+                 "--ticks=24", "--sweep=0.5,1,2", f"--out={out}"]) == 0
+    info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [r["factor"] for r in info["sweep"]] == [0.5, 1.0, 2.0]
+    assert json.load(open(out))["sweep"] == info["sweep"]
+
+    with pytest.raises(SystemExit):   # unknown endpoint is a clean error
+        main(["whatif", f"--ckpt-dir={pipeline['ckpt']}",
+              f"--raw={pipeline['raw']}", '--mix={"nope": 1}',
+              "--ticks=24"])
+
+
+@pytest.mark.slow
 def test_train_profile_capture(pipeline, tmp_path):
     """--profile-dir captures a jax.profiler trace of the first epoch
     (SURVEY.md §5.1: the ML-plane profiling the reference lacks)."""

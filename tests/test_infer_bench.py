@@ -1,0 +1,42 @@
+"""Tier-1 smoke for the rolled-inference benchmark harness:
+`infer_bench.py --quick` must run end to end on every suite pass so the
+fused serving path and the bench's own plumbing cannot rot between full
+bench runs (same pattern as tests/test_etl_bench.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "infer_bench.py")
+
+
+def test_quick_mode_emits_sound_json(tmp_path):
+    out = tmp_path / "infer_bench.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.load(open(out)) == result
+    assert result["schema_version"] == 1
+    assert result["quick"] is True
+    assert result["platform"] == "cpu"
+    day = result["shapes"]["1d"]
+    assert day["windows_per_series"] == 24
+    assert day["host_loop_series_per_sec"] > 0
+    assert day["fused_series_per_sec"] > 0
+    # The point of the fused path.  The full bench bar is >= 2x at the
+    # 1-day shape (committed benchmarks/infer_bench.json: 2.1x); > 1 here
+    # keeps the smoke robust to a noisy shared-CI host while still
+    # catching a silent fallback to the host loop.
+    assert day["fused_vs_host"] > 1.0
+    assert result["shapes"]["1h"]["fused_folded_vs_host"] > 1.0
+    for rec in result["sweep_1d"]:
+        assert rec["folded_fused_s"] > 0
+    # mixed lengths + sweep sizes after warmup compile nothing new
+    assert result["new_compiles_after_warmup"] in (0, None)
+    assert result["jit_cache"]["fused"] >= 1

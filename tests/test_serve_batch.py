@@ -119,26 +119,47 @@ def test_batcher_error_propagates_to_futures():
 
 
 def test_ragged_lengths_trigger_no_new_compiles(pred_multi):
-    """After warming the ladder rungs, mixed (ragged) series lengths must
-    reuse the rung executables: zero new jit compilations."""
+    """After warming the ladder rungs AND the fused per-rung executables,
+    mixed (ragged) series lengths must reuse them: zero new jit
+    compilations on either serving path."""
+    from deeprest_tpu.serve.predictor import rolled_prediction_reference
+
     for rung in pred_multi.ladder.ladder:                       # warmup
         pred_multi.ladder(np.zeros((rung, W, F), np.float32))
+    rng = np.random.default_rng(1)
+    # warm every fused rung too (a series long enough to hit the top rung
+    # pages through all smaller tail rungs as well)
+    for rung in pred_multi.fused.rungs:
+        pred_multi.predict_series(
+            rng.random((rung * W, F)).astype(np.float32))
     warm = pred_multi.ladder.stats()
     cache_warm = pred_multi.jit_cache_size()
-    rng = np.random.default_rng(1)
     for length in (W, W + 1, 2 * W + 3, 3 * W + 5, 5 * W + 7, 8 * W + 2):
+        # fused path (the predict_series default with no batcher attached)
         out = pred_multi.predict_series(
             rng.random((length, F)).astype(np.float32))
         assert out.shape == (length, E, 3)
         assert np.isfinite(out).all()
+        # pinned host path through the shape ladder
+        ref = rolled_prediction_reference(
+            pred_multi.apply_windows, pred_multi.x_stats,
+            pred_multi.y_stats, W,
+            rng.random((length, F)).astype(np.float32))
+        assert ref.shape == (length, E, 3)
     after = pred_multi.ladder.stats()
     assert after["rung_compiles"] == warm["rung_compiles"]
     assert after["compiled_rungs"] == list(pred_multi.ladder.ladder)
     assert after["rung_hits"] > warm["rung_hits"]
     if cache_warm is not None:                 # jax-version-dependent probe
         assert pred_multi.jit_cache_size() == cache_warm
+        # the combined probe covers the fused program too (satellite:
+        # jit_cache_size must not miss the fused rolled executables)
+        stats = pred_multi.jit_cache_stats()
+        assert stats["fused"] >= 1 and stats["apply"] >= 1
     # padding really happened (ragged tails were absorbed, not compiled)
     assert after["padded_windows"] > warm["padded_windows"]
+    fused = pred_multi.fused.stats()
+    assert fused["dispatched_rungs"] == list(pred_multi.fused.rungs)
 
 
 def test_ladder_oversize_chunks_split():
