@@ -1,0 +1,21 @@
+# Repo-level convenience targets.
+#
+#   make lint    graftlint over the package, JSON output (the same gate
+#                tests/test_lint_clean.py enforces in tier-1; see
+#                ANALYSIS.md for the rule catalog)
+#   make native  build the C++ featurizer (native/Makefile)
+#   make tsan    build the thread-sanitized featurizer selftest — the
+#                native-side twin of the TH rule pack
+
+PYTHON ?= python
+
+lint:
+	$(PYTHON) -m deeprest_tpu lint --format json
+
+native:
+	$(MAKE) -C native
+
+tsan:
+	$(MAKE) -C native tsan
+
+.PHONY: lint native tsan

@@ -1,0 +1,28 @@
+"""graftlint: the repo's JAX- and concurrency-aware static analyzer.
+
+Run it via ``deeprest lint`` (cli.py), ``python -m
+deeprest_tpu.analysis``, or programmatically::
+
+    from deeprest_tpu.analysis import lint_paths
+    result = lint_paths(["deeprest_tpu"])
+    assert not result.findings
+
+Rule packs: JX (JAX compile/readback/donation invariants — rules_jax),
+TH (threading — rules_threading), HY (hygiene — rules_hygiene), GL
+(framework meta-rules — core).  ANALYSIS.md is the human catalog.
+"""
+
+from deeprest_tpu.analysis.core import (
+    Finding, LintResult, Project, Rule, all_rules, default_baseline_path,
+    lint_paths, lint_project, lint_sources, load_baseline, save_baseline,
+)
+from deeprest_tpu.analysis.reporters import (
+    render_json, render_rules, render_text,
+)
+
+__all__ = [
+    "Finding", "LintResult", "Project", "Rule", "all_rules",
+    "default_baseline_path", "lint_paths", "lint_project", "lint_sources",
+    "load_baseline", "save_baseline", "render_json", "render_rules",
+    "render_text",
+]

@@ -1,0 +1,8 @@
+"""``python -m deeprest_tpu.analysis`` — alias of ``deeprest lint``."""
+
+import sys
+
+from deeprest_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(["lint", *sys.argv[1:]]))

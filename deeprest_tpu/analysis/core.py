@@ -1,0 +1,461 @@
+"""graftlint core: files, findings, suppressions, baseline, runner.
+
+An AST-based static-analysis framework purpose-built for THIS repo's
+hard-won invariants.  Generic linters cannot see that a ``jax.jit``
+closure capturing trained parameters silently constant-folds a
+differently-rounding mask subgraph (PR 4), or that GSPMD compiles a
+second executable when a step function's output sharding signature
+drifts (PR 2), or that a ``/healthz`` handler reads a reload counter a
+background thread is writing.  graftlint encodes exactly those bug
+classes as mechanical rules and runs over the whole package as a tier-1
+test (tests/test_lint_clean.py), the Python-side twin of the native
+featurizer's ``-fsanitize=thread`` selftest (native/Makefile).
+
+Vocabulary:
+
+- A **rule** (:class:`Rule`) inspects a :class:`Project` (all parsed
+  files) and yields :class:`Finding`s.  Rules register under stable ids
+  (``JX001``...), grouped in packs: JX (JAX compile/readback
+  invariants), TH (threading), HY (hygiene), GL (the linter's own
+  meta-findings, e.g. malformed suppressions).
+- A **suppression** is an in-code comment on (or immediately above) the
+  offending line::
+
+      # graftlint: disable=JX003 -- log-boundary readback, by design
+
+  The reason string after ``--`` is REQUIRED: a bare disable is itself
+  reported (GL001).  Suppressions are the mechanism for *documented,
+  deliberate* deviations; they live next to the code they excuse.
+- The **baseline** is a checked-in JSON list of finding keys that are
+  tolerated repo-wide.  The repo's own baseline
+  (deeprest_tpu/analysis/baseline.json) is EMPTY and the tier-1
+  self-check pins it that way: real findings get fixed (or visibly
+  suppressed with a reason), not baselined away.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    path: str          # posix path relative to the lint root
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def key(self) -> str:
+        """Baseline identity: line numbers are EXCLUDED so unrelated
+        edits above a baselined finding do not churn the file."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- suppressions -----------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:--\s*(.*\S))?\s*$")
+_RULE_ID_RE = re.compile(r"^[A-Z]{2}\d{3}$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    own_line: bool     # comment-only line: applies to the NEXT line too
+
+
+def parse_suppressions(lines: list[str]) -> list[Suppression]:
+    out = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        out.append(Suppression(
+            line=i, rules=rules, reason=m.group(2),
+            own_line=text.lstrip().startswith("#")))
+    return out
+
+
+# -- parsed files -----------------------------------------------------------
+
+
+class SourceFile:
+    """One parsed module plus the lookaside data every rule needs."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.suppressions = parse_suppressions(self.lines)
+        self.syntax_error: SyntaxError | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(source)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        """child → parent map (built lazily, once)."""
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        self._parents[child] = node
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        p = self.parents()
+        while node in p:
+            node = p[node]
+            yield node
+
+    def finding(self, node_or_line, rule: str, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            return Finding(self.rel, node_or_line, 0, rule, message)
+        return Finding(self.rel, getattr(node_or_line, "lineno", 1),
+                       getattr(node_or_line, "col_offset", 0), rule, message)
+
+    def suppressed(self, finding: Finding) -> bool:
+        for s in self.suppressions:
+            if finding.rule not in s.rules or s.reason is None:
+                continue
+            if s.line == finding.line:
+                return True
+            if s.own_line and s.line == finding.line - 1:
+                return True
+        return False
+
+
+class Project:
+    """Every parsed file under the lint root, shared by all rules."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = sorted(files, key=lambda f: f.rel)
+        self.by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def from_dir(cls, root: str) -> "Project":
+        files = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as f:
+                    files.append(SourceFile(rel, f.read()))
+        return cls(files)
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "Project":
+        """Tests and callers with in-memory code: {relpath: source}."""
+        return cls([SourceFile(rel, src) for rel, src in sources.items()])
+
+
+# -- rules ------------------------------------------------------------------
+
+
+class Rule:
+    """Base rule: subclass, set ``id``/``title``/``guards``, implement
+    :meth:`run`.  ``guards`` names the historical incident the rule
+    exists to prevent (surfaced by ``deeprest lint --list-rules`` and
+    ANALYSIS.md)."""
+
+    id: str = "XX000"
+    title: str = ""
+    guards: str = ""
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    rule = rule_cls()
+    if not _RULE_ID_RE.match(rule.id):
+        raise ValueError(f"bad rule id {rule.id!r}")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """The registry, with every built-in rule pack imported."""
+    import importlib
+
+    for pack in ("rules_jax", "rules_threading", "rules_hygiene"):
+        importlib.import_module(f"deeprest_tpu.analysis.{pack}")
+    return dict(_REGISTRY)
+
+
+# -- meta rules (the linter checking its own machinery) ---------------------
+
+
+def _meta_findings(project: Project, known_rules: set[str]) -> list[Finding]:
+    out = []
+    for f in project.files:
+        if f.syntax_error is not None:
+            out.append(Finding(f.rel, f.syntax_error.lineno or 1, 0, "GL003",
+                               f"syntax error: {f.syntax_error.msg}"))
+        for s in f.suppressions:
+            if s.reason is None:
+                out.append(Finding(
+                    f.rel, s.line, 0, "GL001",
+                    "suppression without a reason: append "
+                    "' -- <why this deviation is deliberate>'"))
+            for rid in s.rules:
+                if rid not in known_rules and not rid.startswith("GL"):
+                    out.append(Finding(
+                        f.rel, s.line, 0, "GL002",
+                        f"suppression names unknown rule {rid!r}"))
+    return out
+
+
+GL_RULES = {
+    "GL001": "suppression missing its required reason string",
+    "GL002": "suppression names a rule id that does not exist",
+    "GL003": "file does not parse",
+}
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    keys = data.get("findings", [])
+    if not isinstance(keys, list) or not all(isinstance(k, str) for k in keys):
+        raise ValueError(f"malformed baseline {path!r}: 'findings' must be "
+                         "a list of finding keys")
+    return keys
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted(f.key() for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": keys}, f, indent=2)
+        f.write("\n")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+# -- runner -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]          # live (non-baselined, non-suppressed)
+    baselined: list[Finding]
+    suppressed_count: int
+    files: int
+
+
+def lint_project(project: Project,
+                 rules: Iterable[Rule] | None = None,
+                 baseline_keys: Iterable[str] | None = None) -> LintResult:
+    rule_objs = (list(rules) if rules is not None
+                 else list(all_rules().values()))
+    raw: list[Finding] = _meta_findings(
+        project, {r.id for r in rule_objs} | set(all_rules()))
+    for rule in rule_objs:
+        raw.extend(rule.run(project))
+
+    suppressed = 0
+    kept: list[Finding] = []
+    for f in raw:
+        sf = project.by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f):
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    # Baseline keys consume one finding each (a multiset match): two
+    # identical findings with one baseline entry leave one live.
+    budget: dict[str, int] = {}
+    for k in (baseline_keys or []):
+        budget[k] = budget.get(k, 0) + 1
+    live, base = [], []
+    for f in sorted(kept):
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            base.append(f)
+        else:
+            live.append(f)
+    return LintResult(findings=live, baselined=base,
+                      suppressed_count=suppressed, files=len(project.files))
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Iterable[Rule] | None = None,
+               baseline_keys: Iterable[str] | None = None) -> LintResult:
+    """Lint directories and/or single files (the CLI entry)."""
+    files: list[SourceFile] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(Project.from_dir(path).files)
+        else:
+            rel = os.path.basename(path)
+            with open(path, encoding="utf-8") as f:
+                files.append(SourceFile(rel, f.read()))
+    return lint_project(Project(files), rules=rules,
+                        baseline_keys=baseline_keys)
+
+
+def lint_sources(sources: dict[str, str],
+                 rules: Iterable[Rule] | None = None,
+                 baseline_keys: Iterable[str] | None = None) -> LintResult:
+    """In-memory entry point (fixture tests)."""
+    return lint_project(Project.from_sources(sources), rules=rules,
+                        baseline_keys=baseline_keys)
+
+
+# -- shared AST helpers (used by the rule packs) ----------------------------
+
+
+def call_name(node: ast.AST) -> str | None:
+    """Dotted name of a call target / attribute chain, best effort:
+    ``jax.jit`` → "jax.jit", ``self._ladder.dispatch`` →
+    "self._ladder.dispatch", anything dynamic → None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+
+
+def is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call.func)
+    return name in JIT_NAMES
+
+
+def scope_bound_names(fn: ast.AST) -> set[str]:
+    """Names bound in a function scope: parameters plus every assignment
+    target / import / def at that scope (no descent into nested function
+    or class scopes — those bind their own names)."""
+    bound: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            bound.add(a.arg)
+        if args.vararg:
+            bound.add(args.vararg.arg)
+        if args.kwarg:
+            bound.add(args.kwarg.arg)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(child.name)
+                continue            # its body is a new scope
+            if isinstance(child, ast.ClassDef):
+                bound.add(child.name)
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Name) and isinstance(
+                    child.ctx, (ast.Store, ast.Del)):
+                bound.add(child.id)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                for alias in child.names:
+                    bound.add((alias.asname
+                               or alias.name.split(".")[0]))
+            elif isinstance(child, ast.comprehension):
+                # comprehension targets technically live in their own
+                # scope; treating them as bound here only makes the
+                # closure analysis more conservative
+                for n in ast.walk(child.target):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+            visit(child)
+
+    body = fn.body if isinstance(getattr(fn, "body", None), list) else [fn.body]
+    for stmt in body:
+        if isinstance(stmt, ast.Name) and isinstance(
+                stmt.ctx, (ast.Store, ast.Del)):
+            bound.add(stmt.id)
+        visit(stmt)
+    return bound
+
+
+def enclosing_function_scopes(sf: SourceFile,
+                              node: ast.AST) -> list[ast.AST]:
+    """Enclosing FunctionDef/Lambda chain for ``node`` (innermost first),
+    EXCLUDING the module scope — module globals are not closures."""
+    return [a for a in sf.ancestors(node)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))]
+
+
+def in_loop(sf: SourceFile, node: ast.AST) -> bool:
+    """True when ``node`` sits inside a for/while loop (or comprehension)
+    without an intervening function boundary."""
+    for anc in sf.ancestors(node):
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor,
+                            ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+    return False
+
+
+def iter_functions(sf: SourceFile) -> Iterator[ast.AST]:
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node
+
+
+def walk_no_nested_scopes(node: ast.AST,
+                          skip: Callable[[ast.AST], bool] | None = None,
+                          ) -> Iterator[ast.AST]:
+    """Walk a function/class body without entering nested function or
+    class scopes (``skip`` vetoes additional subtrees)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if skip is not None and skip(n):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,47 @@
+"""graftlint output renderers: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+
+from deeprest_tpu.analysis.core import GL_RULES, LintResult, all_rules
+
+
+def render_text(result: LintResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+    n = len(result.findings)
+    summary = (f"{n} finding{'s' if n != 1 else ''} "
+               f"({len(result.baselined)} baselined, "
+               f"{result.suppressed_count} suppressed) "
+               f"across {result.files} files")
+    lines.append(summary if n else f"clean: {summary}")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps({
+        "version": 1,
+        "files": result.files,
+        "counts": {
+            "findings": len(result.findings),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed_count,
+        },
+        "findings": [f.to_dict() for f in result.findings],
+        "baselined": [f.to_dict() for f in result.baselined],
+    }, indent=2, sort_keys=True)
+
+
+def render_rules() -> str:
+    """``deeprest lint --list-rules``: the catalog with the historical
+    incident each rule guards against."""
+    lines = []
+    for rid, rule in sorted(all_rules().items()):
+        lines.append(f"{rid}  {rule.title}")
+        if rule.guards:
+            lines.append(f"       guards: {rule.guards}")
+    for rid, title in sorted(GL_RULES.items()):
+        lines.append(f"{rid}  {title} (framework meta-rule)")
+    return "\n".join(lines)

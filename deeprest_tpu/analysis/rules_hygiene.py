@@ -1,0 +1,99 @@
+"""Rule pack HY: basic hygiene (unused imports, unreachable code).
+
+Not the point of graftlint — generic linters do this too — but the
+framework needs a cheap, unambiguous rule pack to exercise the
+suppression/baseline machinery, and dead imports in the serving modules
+are real startup cost (every ``import jax`` at module scope delays the
+CLI).  Swept once by hand across the package so the checked-in baseline
+starts (and stays) empty.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeprest_tpu.analysis.core import Finding, Project, Rule, register
+
+
+@register
+class HY001UnusedImport(Rule):
+    id = "HY001"
+    title = "imported name is never used in the module"
+    guards = ("dead imports hide real dependencies and slow cold starts "
+              "(the CLI lazy-imports jax for exactly this reason); "
+              "__init__.py re-export surfaces are exempt")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None or sf.rel.endswith("__init__.py"):
+                continue
+            bindings: list[tuple[str, ast.AST, str]] = []
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        bound = a.asname or a.name.split(".")[0]
+                        bindings.append((bound, node, a.name))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.module == "__future__":
+                        continue
+                    for a in node.names:
+                        if a.name == "*":
+                            continue
+                        bound = a.asname or a.name
+                        bindings.append((bound, node, a.name))
+            if not bindings:
+                continue
+            used: set[str] = set()
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Name):
+                    used.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    pass                      # base Name covers it
+            # names re-exported via __all__ count as used
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == "__all__"
+                                for t in node.targets)
+                        and isinstance(node.value, (ast.List, ast.Tuple))):
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                                e.value, str):
+                            used.add(e.value)
+            seen_lines: set[tuple[int, str]] = set()
+            for bound, node, original in bindings:
+                if bound in used or (node.lineno, bound) in seen_lines:
+                    continue
+                seen_lines.add((node.lineno, bound))
+                yield sf.finding(
+                    node, self.id,
+                    f"import {original!r} (bound as {bound!r}) is never "
+                    "used; delete it")
+
+
+@register
+class HY002UnreachableCode(Rule):
+    id = "HY002"
+    title = "statement is unreachable (follows return/raise/break/continue)"
+    guards = ("dead statements after a terminator are either a logic bug "
+              "or leftovers that mislead the next reader of a hot path")
+
+    _TERMINATORS = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                for field in ("body", "orelse", "finalbody"):
+                    block = getattr(node, field, None)
+                    if not isinstance(block, list):
+                        continue
+                    for prev, stmt in zip(block, block[1:]):
+                        if isinstance(prev, self._TERMINATORS):
+                            yield sf.finding(
+                                stmt, self.id,
+                                "unreachable: the preceding "
+                                f"{type(prev).__name__.lower()} exits "
+                                "this block")
+                            break             # one finding per block

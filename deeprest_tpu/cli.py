@@ -21,7 +21,6 @@ capture a corpus from the real native app instead).
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import sys
 
@@ -721,6 +720,56 @@ def cmd_anomaly(args) -> int:
     return 1 if flagged and args.fail_on_anomaly else 0
 
 
+def cmd_lint(args) -> int:
+    """graftlint: the repo's JAX- and concurrency-aware static analyzer
+    (deeprest_tpu/analysis; rule catalog in ANALYSIS.md).  Exit status:
+    0 clean, 1 non-baselined findings, 2 usage error."""
+    from deeprest_tpu.analysis import (
+        all_rules, default_baseline_path, lint_paths, load_baseline,
+        render_json, render_rules, render_text, save_baseline,
+    )
+
+    if args.list_rules:
+        print(render_rules())
+        return 0
+    rules = None
+    if args.rules:
+        registry = all_rules()
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = sorted(set(wanted) - set(registry))
+        if unknown:
+            print(f"lint: unknown rules {unknown} "
+                  f"(known: {sorted(registry)})")
+            return 2
+        rules = [registry[r] for r in wanted]
+    import os
+
+    paths = args.paths
+    if not paths:
+        import deeprest_tpu
+
+        paths = [os.path.dirname(os.path.abspath(deeprest_tpu.__file__))]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"lint: no such path {missing}")
+        return 2
+    baseline_path = args.baseline or default_baseline_path()
+    try:
+        baseline_keys = load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"lint: {exc}")
+        return 2
+    result = lint_paths(paths, rules=rules, baseline_keys=baseline_keys)
+    if args.write_baseline:
+        save_baseline(baseline_path, result.findings + result.baselined)
+        print(f"lint: baselined {len(result.findings + result.baselined)} "
+              f"findings to {baseline_path}")
+        return 0
+    print(render_json(result) if args.format == "json"
+          else render_text(result))
+    return 1 if result.findings else 0
+
+
 # -- parser -----------------------------------------------------------------
 
 
@@ -964,6 +1013,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "one executable per rung)")
     _add_fused_infer_args(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("lint",
+                       help="graftlint: JAX- and concurrency-aware static "
+                            "analysis over the package (rule catalog: "
+                            "ANALYSIS.md); nonzero exit on non-baselined "
+                            "findings")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the installed "
+                        "deeprest_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None, metavar="JX001,TH001,...",
+                   help="run only these rule ids (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: the checked-in "
+                        "deeprest_tpu/analysis/baseline.json, which is "
+                        "EMPTY and pinned so by tests/test_lint_clean.py)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record every current finding into the baseline "
+                        "instead of reporting (for adopting graftlint on "
+                        "a dirty tree; this repo keeps the baseline empty)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog with the historical "
+                        "incident each rule guards against")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")
     _add_input_args(p)

@@ -87,6 +87,7 @@ class DemoServer:
         return self.httpd.server_address[:2]
 
     def start_background(self) -> "DemoServer":
+        # graftlint: disable=TH001 -- lifecycle handle: start_background/stop run on the owning driver thread only, never in a request handler
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -53,6 +53,7 @@ def export_predictor(pred: Predictor, directory: str) -> str:
     spec = jax.ShapeDtypeStruct(
         (b, pred.window_size, pred.feature_dim), jnp.float32)
     fn = jax.jit(lambda x: pred.model.apply(
+        # graftlint: disable=JX001 -- deliberate: the artifact's whole point is baking the trained params into the serialized module as constants; bit parity vs the in-process path is pinned by tests/test_export_serve.py
         {"params": pred.params}, x, deterministic=True))
     exported = jexport.export(fn, platforms=_PLATFORMS)(spec)
     with open(os.path.join(directory, ARTIFACT_BLOB), "wb") as f:

@@ -277,6 +277,7 @@ class FusedRolledEngine:
         use_device_delta = self._has_delta and integrate
         outs: list[np.ndarray | None] = [None] * len(arrays)
         for out_dev, chunk in dispatched:
+            # graftlint: disable=JX003 -- designed sink: every page was already dispatched async above; this loop is the pipeline's readback phase
             arr = np.asarray(out_dev)                      # [R, W, E, Q]
             # host-side invert, in the reference's exact op order/layout,
             # for the columns the device left normalized (bit parity)

@@ -162,10 +162,18 @@ class PredictionService:
 
     def __init__(self, predictor, synthesizer=None, backend: str = "",
                  reloader=None, batching: BatcherConfig | None = None):
-        self.predictor = predictor
         self.backend = backend
         self._synthesizer = synthesizer
         self._reloader = reloader
+        # Guards the SWAPPABLE serving state below: ThreadingHTTPServer
+        # runs every request on its own thread, and maybe_reload() swaps
+        # these mid-flight (found by graftlint TH001: /healthz read the
+        # reload counter and backend refs while maybe_reload wrote them).
+        # Handlers snapshot the references under the lock and then work
+        # on locals, so no device dispatch ever runs while holding it;
+        # batcher drains (seconds) also happen OUTSIDE the lock.
+        self._lock = threading.Lock()
+        self.predictor = predictor
         self.reloads = 0
         self.batcher: MicroBatcher | None = None
         self.batching = None
@@ -174,24 +182,38 @@ class PredictionService:
         if batching is not None:
             self.enable_batching(batching)
 
+    # -- swappable-state management (all writes under self._lock) --------
+
+    def _snapshot(self):
+        """One consistent view of the serving backend for a request:
+        ``(predictor, whatif, batcher, reloads)``.  A reload that lands
+        mid-request affects the NEXT request; this one keeps serving the
+        internally-consistent backend it started with."""
+        with self._lock:
+            return self.predictor, self.whatif, self.batcher, self.reloads
+
     def enable_batching(self, config: BatcherConfig) -> None:
         """(Re)build the cross-request MicroBatcher over the current
         backend's shape ladder and route its traffic through it."""
-        self.batching = config
-        self._rebuild_batcher(self.predictor)
-
-    def _rebuild_batcher(self, predictor) -> None:
-        old, self.batcher = self.batcher, None
+        with self._lock:
+            pred = self.predictor
+        fresh = MicroBatcher(pred.ladder, config)
+        pred.attach_batcher(fresh)
+        with self._lock:
+            old, self.batcher = self.batcher, fresh
+            self.batching = config
         if old is not None:
-            old.close()
-        if self.batching is not None:
-            self.batcher = MicroBatcher(predictor.ladder, self.batching)
-            predictor.attach_batcher(self.batcher)
+            old.close()               # drain outside the lock
 
     def close(self) -> None:
         """Release the batcher's worker thread (idempotent)."""
-        self.batching = None
-        self._rebuild_batcher(self.predictor)
+        with self._lock:
+            old, self.batcher = self.batcher, None
+            self.batching = None
+            pred = self.predictor
+        pred.attach_batcher(None)
+        if old is not None:
+            old.close()
 
     def maybe_reload(self) -> None:
         """Swap in a newer backend if the reloader has one (serving a
@@ -201,35 +223,48 @@ class PredictionService:
         fresh = self._reloader.poll()
         if fresh is None:
             return
-        self.predictor = fresh
-        self.reloads += 1
-        # The fresh backend gets its own batcher; the old one drains and
-        # closes — a request that raced the swap falls back to the direct
-        # laddered path (BatcherClosed is handled in apply_windows).
-        self._rebuild_batcher(fresh)
-        if self._synthesizer is not None:
-            self.whatif = WhatIfEstimator(fresh, self._synthesizer)
+        # Build the fresh backend's batcher/estimator BEFORE publishing,
+        # so other threads only ever see fully-wired backends; the old
+        # batcher drains and closes after the swap — a request that
+        # raced the swap falls back to the direct laddered path
+        # (BatcherClosed is handled in apply_windows).
+        with self._lock:
+            batching = self.batching
+        fresh_batcher = None
+        if batching is not None:
+            fresh_batcher = MicroBatcher(fresh.ladder, batching)
+            fresh.attach_batcher(fresh_batcher)
+        fresh_whatif = (WhatIfEstimator(fresh, self._synthesizer)
+                        if self._synthesizer is not None else None)
+        with self._lock:
+            old, self.batcher = self.batcher, fresh_batcher
+            self.predictor = fresh
+            self.whatif = fresh_whatif
+            self.reloads += 1
+        if old is not None:
+            old.close()
 
     # -- GET ------------------------------------------------------------
 
     def healthz(self) -> dict:
+        pred, _, batcher, reloads = self._snapshot()
         out = {
             "ok": True,
             "backend": self.backend,
-            "num_metrics": len(self.predictor.metric_names),
-            "window_size": self.predictor.window_size,
-            "reloads": self.reloads,
+            "num_metrics": len(pred.metric_names),
+            "window_size": pred.window_size,
+            "reloads": reloads,
         }
         # Queue depth + shape-ladder hit stats ride on the liveness probe
         # (additive keys: the wire protocol's existing fields are
         # untouched).  Batching disabled still reports the backend's
         # ladder so compile behavior is observable either way.
-        if self.batcher is not None:
-            out["batcher"] = self.batcher.stats()
-        elif getattr(self.predictor, "ladder", None) is not None:
+        if batcher is not None:
+            out["batcher"] = batcher.stats()
+        elif getattr(pred, "ladder", None) is not None:
             out["batcher"] = None
-            out["shape_ladder"] = self.predictor.ladder.stats()
-        fused = getattr(self.predictor, "fused", None)
+            out["shape_ladder"] = pred.ladder.stats()
+        fused = getattr(pred, "fused", None)
         if fused is not None:
             # page/dispatch counters of the fused rolled-inference engine
             # (additive key; the wire protocol's existing fields are
@@ -238,65 +273,69 @@ class PredictionService:
         return out
 
     def meta(self) -> dict:
+        pred, whatif, _, _ = self._snapshot()
         return {
             "backend": self.backend,
-            "metric_names": self.predictor.metric_names,
-            "quantiles": list(self.predictor.quantiles),
-            "window_size": self.predictor.window_size,
-            "feature_dim": self.predictor.feature_dim,
-            "whatif_endpoints": (self.whatif.endpoints
-                                 if self.whatif is not None else None),
+            "metric_names": pred.metric_names,
+            "quantiles": list(pred.quantiles),
+            "window_size": pred.window_size,
+            "feature_dim": pred.feature_dim,
+            "whatif_endpoints": (whatif.endpoints
+                                 if whatif is not None else None),
         }
 
     # -- POST -----------------------------------------------------------
 
-    def _traffic_array(self, payload: dict) -> np.ndarray:
+    @staticmethod
+    def _traffic_array(payload: dict, pred) -> np.ndarray:
         traffic = _as_array(payload, "traffic", 2)
-        if traffic.shape[1] != self.predictor.feature_dim:
+        if traffic.shape[1] != pred.feature_dim:
             raise ServingError(
                 f"traffic feature dim {traffic.shape[1]} != model "
-                f"{self.predictor.feature_dim}")
-        if len(traffic) < self.predictor.window_size:
+                f"{pred.feature_dim}")
+        if len(traffic) < pred.window_size:
             raise ServingError(
                 f"traffic length {len(traffic)} < window_size "
-                f"{self.predictor.window_size}")
+                f"{pred.window_size}")
         return traffic
 
     def predict(self, payload: dict) -> dict:
-        traffic = self._traffic_array(payload)
-        preds = self.predictor.predict_series(traffic)        # [T, E, Q]
-        dm = getattr(self.predictor, "delta_mask", None)
+        pred, _, _, _ = self._snapshot()
+        traffic = self._traffic_array(payload, pred)
+        preds = pred.predict_series(traffic)                  # [T, E, Q]
+        dm = getattr(pred, "delta_mask", None)
         return {
-            "metric_names": self.predictor.metric_names,
-            "quantiles": list(self.predictor.quantiles),
+            "metric_names": pred.metric_names,
+            "quantiles": list(pred.quantiles),
             "predictions": preds.tolist(),
             # Delta-trained metrics are a RELATIVE (rollout-from-zero)
             # level series — clients must re-anchor them to an observed
             # level before treating values as absolute utilization.
             "relative_metrics": [
-                m for e, m in enumerate(self.predictor.metric_names)
+                m for e, m in enumerate(pred.metric_names)
                 if dm is not None and bool(dm[e])
             ],
         }
 
-    def _require_whatif(self) -> WhatIfEstimator:
-        if self.whatif is None:
+    def _require_whatif(self, whatif) -> WhatIfEstimator:
+        if whatif is None:
             raise ServingError(
                 "what-if estimation unavailable: server started without a "
                 "corpus to fit the trace synthesizer (--raw)", status=503)
-        return self.whatif
+        return whatif
 
-    def _traffic_program(self, payload: dict, key: str) -> list[dict]:
+    @staticmethod
+    def _traffic_program(payload: dict, key: str, pred) -> list[dict]:
         prog = payload.get(key)
         if (not isinstance(prog, list) or not prog
                 or not all(isinstance(p, dict) for p in prog)):
             raise ServingError(
                 f"field {key!r} must be a non-empty list of "
                 "{endpoint: count} objects")
-        if len(prog) < self.predictor.window_size:
+        if len(prog) < pred.window_size:
             raise ServingError(
                 f"{key!r} length {len(prog)} < window_size "
-                f"{self.predictor.window_size}")
+                f"{pred.window_size}")
         return prog
 
     @staticmethod
@@ -307,8 +346,9 @@ class PredictionService:
             raise ServingError(f"bad seed: {e}") from None
 
     def whatif_estimate(self, payload: dict) -> dict:
-        est = self._require_whatif()
-        prog = self._traffic_program(payload, "expected_traffic")
+        pred, whatif, _, _ = self._snapshot()
+        est = self._require_whatif(whatif)
+        prog = self._traffic_program(payload, "expected_traffic", pred)
         try:
             series = est.estimate(prog, seed=self._seed(payload))
         except KeyError as e:   # unknown endpoint in the traffic program
@@ -319,9 +359,10 @@ class PredictionService:
         }}
 
     def whatif_scaling(self, payload: dict) -> dict:
-        est = self._require_whatif()
-        base = self._traffic_program(payload, "baseline_traffic")
-        hypo = self._traffic_program(payload, "hypothetical_traffic")
+        pred, whatif, _, _ = self._snapshot()
+        est = self._require_whatif(whatif)
+        base = self._traffic_program(payload, "baseline_traffic", pred)
+        hypo = self._traffic_program(payload, "hypothetical_traffic", pred)
         try:
             factors = est.scaling_factor(base, hypo, seed=self._seed(payload))
         except KeyError as e:   # unknown endpoint in either program
@@ -329,20 +370,21 @@ class PredictionService:
         return {"scaling_factors": factors}
 
     def anomaly(self, payload: dict) -> dict:
-        traffic = self._traffic_array(payload)
+        pred, _, _, _ = self._snapshot()
+        traffic = self._traffic_array(payload, pred)
         observed = _as_array(payload, "observed", 2)
         if len(traffic) != len(observed):
             raise ServingError("traffic and observed must have equal length")
-        if observed.shape[1] != len(self.predictor.metric_names):
+        if observed.shape[1] != len(pred.metric_names):
             raise ServingError(
                 f"observed has {observed.shape[1]} metrics, model has "
-                f"{len(self.predictor.metric_names)}")
+                f"{len(pred.metric_names)}")
         try:
             tolerance = float(payload.get("tolerance", 0.10))
             min_run = int(payload.get("min_run", 5))
         except (TypeError, ValueError) as e:
             raise ServingError(f"bad tolerance/min_run: {e}") from None
-        detector = AnomalyDetector(self.predictor, tolerance=tolerance,
+        detector = AnomalyDetector(pred, tolerance=tolerance,
                                    min_run=min_run)
         reports = detector.check(traffic, observed)
         return {"reports": [{
@@ -434,6 +476,7 @@ class PredictionServer:
         return self._httpd.server_address[:2]
 
     def start(self) -> "PredictionServer":
+        # graftlint: disable=TH001 -- lifecycle handle: start/stop run on the owning driver thread only, never in a request handler
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()

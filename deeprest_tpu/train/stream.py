@@ -696,11 +696,11 @@ class StreamingTrainer:
                                         should_stop, deadline_s)
 
     def _finish_refresh(self, stall_s: float, lag: int,
-                        tailer) -> RefreshResult:
+                        dropped: int) -> RefreshResult:
         r = self.refresh()
         r.etl_stall_s = stall_s
         r.etl_lag_buckets = lag
-        r.etl_dropped = int(getattr(tailer, "dropped", 0))
+        r.etl_dropped = dropped
         return r
 
     def _run_serial(self, tailer, max_refreshes, should_stop,
@@ -720,7 +720,8 @@ class StreamingTrainer:
                     self.ingest(bucket)
                 stall += time.monotonic() - w0
             if self.ready():
-                yield self._finish_refresh(stall, 0, tailer)
+                yield self._finish_refresh(
+                    stall, 0, int(getattr(tailer, "dropped", 0)))
                 stall = 0.0
                 performed += 1
                 if max_refreshes is not None and performed >= max_refreshes:
@@ -737,9 +738,14 @@ class StreamingTrainer:
         stop = threading.Event()
 
         def etl_loop():
+            # The tailer lives on THIS thread only: its counters cross to
+            # the train loop through the buffer's lock-protected snapshot
+            # (note_dropped), never as bare attribute reads across threads
+            # (graftlint TH001 found the original off-lock sharing).
             try:
                 while not stop.is_set():
                     got = tailer.poll()
+                    buf.note_dropped(int(getattr(tailer, "dropped", 0)))
                     if got:
                         # One queue item per poll batch, kept atomic so the
                         # train thread's readiness checks land on the same
@@ -775,7 +781,8 @@ class StreamingTrainer:
                     for feat in batch:
                         self._ingest_featurized(feat)
                 if self.ready():
-                    yield self._finish_refresh(stall, buf.pending(), tailer)
+                    yield self._finish_refresh(stall, buf.pending(),
+                                               buf.dropped())
                     stall = 0.0
                     performed += 1
                     if max_refreshes is not None \
@@ -803,6 +810,7 @@ class _EtlBuffer:
         self._cv = threading.Condition()
         self._batches: deque[list] = deque()
         self._buckets = 0
+        self._dropped = 0          # tailer's malformed-line counter snapshot
         self._exc: BaseException | None = None
         self._closed = False
 
@@ -835,6 +843,18 @@ class _EtlBuffer:
             self._exc = exc
             self._closed = True
             self._cv.notify_all()
+
+    def note_dropped(self, total: int) -> None:
+        """ETL-thread side: publish the tailer's cumulative malformed-line
+        count.  The tailer object itself is owned by the ETL thread; this
+        snapshot is the only form its counters cross the thread boundary
+        in (lock-protected, so the train loop never reads them racily)."""
+        with self._cv:
+            self._dropped = total
+
+    def dropped(self) -> int:
+        with self._cv:
+            return self._dropped
 
     def pending(self) -> int:
         with self._cv:

@@ -387,6 +387,7 @@ class Trainer:
             else:
                 steps += 1
             if log_every and self._global_step % log_every == 0:
+                # graftlint: disable=JX003 -- designed sink: one scalar readback per log_every steps, the logging contract
                 print(f"step {self._global_step}: loss {float(loss):.6f}")
         jax.block_until_ready(state.params)
         if measuring:
@@ -438,6 +439,7 @@ class Trainer:
             prev = self._global_step
             self._global_step += real
             if log_every and prev // log_every != self._global_step // log_every:
+                # graftlint: disable=JX003 -- designed sink: one [S] readback per superstep, only when a log boundary passed
                 vals = np.asarray(losses_c)     # one readback, ≥1 boundary
                 for gs in range(prev + 1, self._global_step + 1):
                     if gs % log_every == 0:
@@ -492,6 +494,7 @@ class Trainer:
                 xb = feed_replicated(self.mesh, bundle.x_test[sel])
                 yb = feed_replicated(self.mesh, bundle.y_test[sel])
                 p, l = self._eval_step(state.params, xb, yb)
+            # graftlint: disable=JX003 -- designed sink: eval pages through windows precisely so only one chunk is device-resident; the loss stays on device (loss_terms)
             preds_chunks.append(np.asarray(gather_to_host(p)))
             # Window-weighted loss accumulates as a DEVICE scalar (f32 even
             # for bf16 models) — no per-chunk float(l) sync; one readback
@@ -524,6 +527,7 @@ class Trainer:
         errors = {"deepr": np.abs(preds_denorm - labels_denorm)}
         if baseline_preds:
             for method, series in baseline_preds.items():
+                # graftlint: disable=JX003 -- host data: baseline predictions are numpy arrays, no device sync happens here
                 series = np.array(np.asarray(series)[idx], copy=True)
                 if bundle._has_delta():
                     series[..., mask] += (labels_denorm[:, :1, mask]

@@ -1,0 +1,654 @@
+"""graftlint framework tests: every rule fires on its minimal bad
+fixture and stays SILENT on the minimally-corrected variant (the
+false-positive guard), plus the suppression, baseline, reporter, and CLI
+machinery.  tests/test_lint_clean.py is the companion self-check that
+pins the real package clean."""
+
+import json
+
+import pytest
+
+from deeprest_tpu.analysis import (
+    all_rules, lint_sources, load_baseline, render_json, render_text,
+    save_baseline,
+)
+
+
+def findings_for(rule_id: str, source: str, rel: str = "mod.py"):
+    rules = [all_rules()[rule_id]] if rule_id else []
+    result = lint_sources({rel: source}, rules=rules)
+    return [f for f in result.findings if not rule_id or f.rule == rule_id]
+
+
+def assert_pair(rule_id: str, bad: str, good: str, rel: str = "mod.py"):
+    fired = findings_for(rule_id, bad, rel)
+    assert fired, f"{rule_id} must fire on the bad fixture"
+    assert all(f.rule == rule_id for f in fired)
+    silent = findings_for(rule_id, good, rel)
+    assert not silent, (f"{rule_id} false positive on the corrected "
+                        f"fixture: {silent}")
+
+
+# ---------------------------------------------------------------------------
+# JX001: closure-captured params in jitted functions
+
+
+JX001_BAD = """
+import jax
+
+def make_step(params, model):
+    def step(x):
+        return model.apply(params, x)
+    return jax.jit(step)
+"""
+
+JX001_GOOD = """
+import jax
+
+def make_step(model):
+    def step(params, x):
+        return model.apply(params, x)
+    return jax.jit(step)
+"""
+
+
+def test_jx001_pair():
+    assert_pair("JX001", JX001_BAD, JX001_GOOD)
+
+
+def test_jx001_attribute_chain_capture():
+    bad = """
+import jax
+
+def export(pred):
+    fn = jax.jit(lambda x: pred.model.apply({"p": pred.params}, x))
+    return fn
+"""
+    assert findings_for("JX001", bad)
+
+
+def test_jx001_local_helper_function_not_flagged():
+    # trainer.py's `pin_state` pattern: a closure-captured local FUNCTION
+    # whose name matches the device-state pattern is a static callable
+    src = """
+import jax
+
+def build(mesh):
+    def pin_state(s):
+        return s
+    def step(state):
+        return pin_state(state)
+    return jax.jit(step)
+"""
+    assert not findings_for("JX001", src)
+
+
+# ---------------------------------------------------------------------------
+# JX002: recompile hazards
+
+
+JX002_LOOP_BAD = """
+import jax
+
+def run(fns, xs):
+    outs = []
+    for fn in fns:
+        outs.append(jax.jit(fn)(xs))
+    return outs
+"""
+
+JX002_LOOP_GOOD = """
+import jax
+
+def run(fn, xs_list):
+    jfn = jax.jit(fn)
+    outs = []
+    for xs in xs_list:
+        outs.append(jfn(xs))
+    return outs
+"""
+
+
+def test_jx002_jit_in_loop_pair():
+    assert_pair("JX002", JX002_LOOP_BAD, JX002_LOOP_GOOD)
+
+
+def test_jx002_fresh_lambda_immediately_invoked():
+    bad = """
+import jax
+
+def apply_once(x):
+    return jax.jit(lambda y: y * 2)(x)
+"""
+    good = """
+import jax
+
+_double = jax.jit(lambda y: y * 2)
+
+def apply_once(x):
+    return _double(x)
+"""
+    assert_pair("JX002", bad, good)
+
+
+def test_jx002_nonliteral_static_argnums():
+    bad = """
+import jax
+
+def build(fn, which):
+    return jax.jit(fn, static_argnums=which)
+"""
+    good = """
+import jax
+
+def build(fn):
+    return jax.jit(fn, static_argnums=(0, 2))
+"""
+    assert_pair("JX002", bad, good)
+
+
+# ---------------------------------------------------------------------------
+# JX003: device→host readbacks in loops (hot modules only)
+
+
+JX003_BAD = """
+import numpy as np
+
+def epoch(step, state, batches):
+    losses = []
+    for b in batches:
+        state, loss = step(state, b)
+        losses.append(float(loss))
+    return state, losses
+"""
+
+JX003_GOOD = """
+import numpy as np
+import jax.numpy as jnp
+
+def epoch(step, state, batches):
+    losses = []
+    for b in batches:
+        state, loss = step(state, b)
+        losses.append(loss)
+    return state, np.asarray(jnp.stack(losses))
+"""
+
+
+def test_jx003_pair_in_hot_module():
+    assert_pair("JX003", JX003_BAD, JX003_GOOD, rel="train/trainer.py")
+
+
+def test_jx003_silent_outside_hot_modules():
+    # the same readback in host-side ETL code is not a pipeline stall
+    assert not findings_for("JX003", JX003_BAD, rel="data/ingest.py")
+
+
+def test_jx003_item_and_asarray_kinds():
+    bad = """
+import numpy as np
+
+def drain(xs):
+    out = [np.asarray(x) for x in xs]
+    tot = 0.0
+    for x in xs:
+        tot += x.item()
+    return out, tot
+"""
+    fired = findings_for("JX003", bad, rel="serve/fused.py")
+    kinds = {f.message.split()[0] for f in fired}
+    assert any("asarray" in k for k in kinds)
+    assert any("item" in k for k in kinds)
+
+
+# ---------------------------------------------------------------------------
+# JX004: use-after-donation
+
+
+JX004_BAD = """
+import jax
+
+step = jax.jit(lambda s, x: (s + x, x), donate_argnums=0)
+
+def train(state, xs):
+    new_state, out = step(state, xs)
+    return new_state, state.step
+"""
+
+JX004_GOOD = """
+import jax
+
+step = jax.jit(lambda s, x: (s + x, x), donate_argnums=0)
+
+def train(state, xs):
+    state, out = step(state, xs)
+    return state, state.step
+"""
+
+
+def test_jx004_pair():
+    assert_pair("JX004", JX004_BAD, JX004_GOOD)
+
+
+def test_jx004_self_attribute_callable_and_rebinding_loop():
+    # the trainer idiom: donated callable held on self, canonical
+    # `state, loss = self._step(state, ...)` rebinding inside a loop
+    good = """
+import jax
+
+class T:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=0)
+
+    def epoch(self, state, batches):
+        for b in batches:
+            state, loss = self._step(state, b)
+        return state
+"""
+    bad = """
+import jax
+
+class T:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=0)
+
+    def epoch(self, state, batches):
+        for b in batches:
+            new, loss = self._step(state, b)
+        return state
+"""
+    assert_pair("JX004", bad, good)
+
+
+# ---------------------------------------------------------------------------
+# TH001: attribute races
+
+
+TH001_BAD = """
+import threading
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        self.count += 1
+
+    def healthz(self):
+        return {"count": self.count}
+"""
+
+TH001_GOOD = """
+import threading
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        with self._lock:
+            self.count += 1
+
+    def healthz(self):
+        with self._lock:
+            return {"count": self.count}
+"""
+
+
+def test_th001_pair():
+    assert_pair("TH001", TH001_BAD, TH001_GOOD)
+
+
+def test_th001_http_handler_module_counts_as_concurrent():
+    # no explicit Thread spawn: ThreadingHTTPServer makes every method a
+    # potential concurrent entry (the /healthz reload-counter bug class)
+    bad = """
+from http.server import ThreadingHTTPServer
+
+class Service:
+    def __init__(self):
+        self.reloads = 0
+
+    def maybe_reload(self):
+        self.reloads += 1
+
+    def healthz(self):
+        return {"reloads": self.reloads}
+"""
+    good = """
+import threading
+from http.server import ThreadingHTTPServer
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reloads = 0
+
+    def maybe_reload(self):
+        with self._lock:
+            self.reloads += 1
+
+    def healthz(self):
+        with self._lock:
+            return {"reloads": self.reloads}
+"""
+    assert_pair("TH001", bad, good)
+
+
+def test_th001_init_only_attributes_are_silent():
+    src = """
+import threading
+
+class Worker:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        return self.cfg
+"""
+    assert not findings_for("TH001", src)
+
+
+def test_th001_shared_capture_pair():
+    # the streaming-ETL pattern: an unsynchronized object captured by the
+    # thread target AND still used by the spawner after start()
+    bad = """
+import threading
+
+class Tailer:
+    def __init__(self):
+        self.dropped = 0
+
+class Runner:
+    def run(self, tailer):
+        def loop():
+            tailer.poll()
+        t = threading.Thread(target=loop)
+        t.start()
+        while True:
+            print(tailer.dropped)
+"""
+    good = """
+import threading
+
+class Tailer:
+    def __init__(self):
+        self.dropped = 0
+
+class Buffer:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._dropped = 0
+
+    def note(self, n):
+        with self._cv:
+            self._dropped = n
+
+    def dropped(self):
+        with self._cv:
+            return self._dropped
+
+class Runner:
+    def run(self, tailer):
+        buf = Buffer()
+
+        def loop():
+            tailer.poll()
+            buf.note(tailer.dropped)
+        t = threading.Thread(target=loop)
+        t.start()
+        while True:
+            print(buf.dropped())
+"""
+    assert_pair("TH001", bad, good)
+
+
+# ---------------------------------------------------------------------------
+# TH002: lock-ordering cycles
+
+
+TH002_BAD = """
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+TH002_GOOD = """
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+
+def test_th002_pair():
+    assert_pair("TH002", TH002_BAD, TH002_GOOD)
+
+
+def test_th002_cross_class_cycle_via_annotated_attr():
+    bad = """
+import threading
+
+class Ladder:
+    def __init__(self, svc: "Service"):
+        self._lock = threading.Lock()
+        self._svc = svc
+
+    def dispatch(self):
+        with self._lock:
+            self._svc.note()
+
+class Service:
+    def __init__(self, ladder: Ladder):
+        self._lock = threading.Lock()
+        self._ladder = ladder
+
+    def note(self):
+        with self._lock:
+            pass
+
+    def serve(self):
+        with self._lock:
+            self._ladder.dispatch()
+"""
+    fired = findings_for("TH002", bad)
+    assert fired and "cycle" in fired[0].message
+
+
+# ---------------------------------------------------------------------------
+# HY rules
+
+
+def test_hy001_unused_import_pair():
+    bad = "import os\nimport sys\n\nprint(sys.argv)\n"
+    good = "import sys\n\nprint(sys.argv)\n"
+    assert_pair("HY001", bad, good)
+
+
+def test_hy001_init_py_exempt():
+    assert not findings_for("HY001", "from mod import thing\n",
+                            rel="pkg/__init__.py")
+
+
+def test_hy002_unreachable_pair():
+    bad = "def f():\n    return 1\n    print('dead')\n"
+    good = "def f():\n    return 1\n"
+    assert_pair("HY002", bad, good)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_with_reason_silences_finding():
+    src = ("import os\n"
+           "# graftlint: disable=HY001 -- kept for the doctest namespace\n"
+           "import sys\n\nprint(sys.argv)\n")
+    # os (line 1) still fires; sys would not have fired anyway — move the
+    # suppression to the real finding:
+    fired = findings_for("HY001", src)
+    assert len(fired) == 1 and fired[0].line == 1
+    src2 = ("# graftlint: disable=HY001 -- kept for the doctest namespace\n"
+            "import os\n"
+            "import sys\n\nprint(sys.argv)\n")
+    assert not findings_for("HY001", src2)
+
+
+def test_suppression_trailing_same_line():
+    src = ("import os  # graftlint: disable=HY001 -- re-exported via star\n"
+           "print(1)\n")
+    assert not findings_for("HY001", src)
+
+
+def test_suppression_without_reason_is_gl001_and_does_not_suppress():
+    src = ("# graftlint: disable=HY001\n"
+           "import os\n"
+           "print(1)\n")
+    result = lint_sources({"mod.py": src})
+    rules = {f.rule for f in result.findings}
+    assert "GL001" in rules, "bare suppression must be reported"
+    assert "HY001" in rules, "a reasonless suppression must not suppress"
+
+
+def test_suppression_unknown_rule_is_gl002():
+    src = ("# graftlint: disable=ZZ999 -- because\n"
+           "print(1)\n")
+    result = lint_sources({"mod.py": src})
+    assert any(f.rule == "GL002" for f in result.findings)
+
+
+def test_syntax_error_is_gl003_not_a_crash():
+    result = lint_sources({"mod.py": "def broken(:\n"})
+    assert any(f.rule == "GL003" for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = "import os\nprint(1)\n"
+    first = lint_sources({"mod.py": src})
+    assert first.findings
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), first.findings)
+    keys = load_baseline(str(path))
+    assert keys == sorted(f.key() for f in first.findings)
+    second = lint_sources({"mod.py": src}, baseline_keys=keys)
+    assert not second.findings
+    assert len(second.baselined) == len(first.findings)
+    # keys are line-independent: shifting the file must not churn
+    shifted = lint_sources({"mod.py": "\n\n" + src}, baseline_keys=keys)
+    assert not shifted.findings
+
+
+def test_empty_baseline_masks_nothing():
+    result = lint_sources({"mod.py": "import os\nprint(1)\n"},
+                          baseline_keys=[])
+    assert result.findings and not result.baselined
+
+
+# ---------------------------------------------------------------------------
+# reporters
+
+
+def test_json_reporter_schema():
+    result = lint_sources({"mod.py": "import os\nprint(1)\n"})
+    payload = json.loads(render_json(result))
+    assert payload["version"] == 1
+    assert payload["counts"]["findings"] == len(result.findings) >= 1
+    f = payload["findings"][0]
+    assert {"path", "line", "col", "rule", "message"} <= set(f)
+    assert f["rule"] == "HY001"
+
+
+def test_text_reporter_clean_and_dirty():
+    dirty = render_text(lint_sources({"mod.py": "import os\nprint(1)\n"}))
+    assert "mod.py:1:1: HY001" in dirty
+    clean = render_text(lint_sources({"mod.py": "print(1)\n"}))
+    assert clean.startswith("clean:")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_lint_exit_codes_and_baseline(tmp_path, capsys):
+    from deeprest_tpu.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nprint(1)\n")
+    baseline = tmp_path / "baseline.json"
+
+    assert main(["lint", str(bad), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "HY001" in out
+
+    assert main(["lint", str(bad), "--baseline", str(baseline),
+                 "--write-baseline"]) == 0
+    assert main(["lint", str(bad), "--baseline", str(baseline)]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+    assert main(["lint", str(bad), "--baseline", str(baseline),
+                 "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["findings"] == 0
+    assert payload["counts"]["baselined"] == 1
+
+
+def test_cli_lint_unknown_rule_is_usage_error(tmp_path):
+    from deeprest_tpu.cli import main
+
+    f = tmp_path / "ok.py"
+    f.write_text("print(1)\n")
+    assert main(["lint", str(f), "--rules", "QQ123"]) == 2
+
+
+def test_cli_list_rules(capsys):
+    from deeprest_tpu.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("JX001", "JX002", "JX003", "JX004", "TH001", "TH002",
+                "HY001", "HY002", "GL001"):
+        assert rid in out
+    assert "PR 4" in out        # rules cite the incidents they guard
+
+
+def test_rule_registry_complete():
+    rules = all_rules()
+    assert {"JX001", "JX002", "JX003", "JX004",
+            "TH001", "TH002", "HY001", "HY002"} <= set(rules)
+    for rule in rules.values():
+        assert rule.title and rule.guards

@@ -1,0 +1,46 @@
+"""Tier-1 self-check: the whole package lints clean against an EMPTY
+baseline.
+
+This is the enforcement half of graftlint: tests/test_analysis.py proves
+each rule fires and stays silent correctly; this test pins deeprest_tpu
+itself at zero non-baselined findings forever.  A PR that introduces a
+jit closure capture (JX001/PR 4 bug class), a recompile hazard, an
+off-lock shared attribute (TH001), or a lock cycle fails tier-1 here —
+the same way a racy native featurizer change fails the tsan selftest.
+
+Budget: the whole run (parse + all rule packs over ~60 files) must stay
+well under 10 s so it remains a tier-1 test.
+"""
+
+import os
+import time
+
+import deeprest_tpu
+from deeprest_tpu.analysis import (
+    default_baseline_path, lint_paths, load_baseline, render_text,
+)
+
+PACKAGE_DIR = os.path.dirname(os.path.abspath(deeprest_tpu.__file__))
+
+
+def test_package_lints_clean_with_empty_baseline():
+    t0 = time.monotonic()
+    baseline = load_baseline(default_baseline_path())
+    assert baseline == [], (
+        "the checked-in baseline must stay EMPTY: fix findings (or "
+        "suppress them in-code with a reason), do not baseline them; "
+        f"found {baseline}")
+    result = lint_paths([PACKAGE_DIR], baseline_keys=baseline)
+    assert result.files >= 50, "package walk looks truncated"
+    assert not result.findings, "\n" + render_text(result)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, (
+        f"lint self-check took {elapsed:.1f}s — over the 10s tier-1 "
+        "budget; profile the rule packs before merging")
+
+
+def test_suppressions_all_carry_reasons():
+    # Redundant with GL001 (which the clean run above enforces), but
+    # explicit: every in-code deviation must say WHY.
+    result = lint_paths([PACKAGE_DIR], rules=[])
+    assert not [f for f in result.findings if f.rule == "GL001"]
