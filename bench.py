@@ -99,12 +99,19 @@ CPU_TIMEOUT_S = 2400         # flagship f32 CPU steps are ~7s each
 # Step counts are sized so the end-of-trial host readback (the only sync
 # primitive that provably round-trips on the tunneled TPU backend — see
 # measure_main) is amortized to <2% of the trial.
+#
+# grad_accum_G: the window-coalescing factor for the schema-v6
+# coalesced_steps_per_sec measurement (G plan steps fused into one
+# update, G·B recurrence rows — TrainConfig.grad_accum_windows).  4 is
+# the widest the flagship bf16 TRAINING kernel's VMEM block plan fits
+# (ops/pallas_gru.block_plan: G=8 overflows scoped VMEM even at the
+# minimum block); the CPU fallback uses 2 to bound its ~7 s/step trials.
 FULL = {"warmup": 5, "steps": 100, "trials": 3, "dtype": "bfloat16",
-        "superstep_S": 8}
+        "superstep_S": 8, "grad_accum_G": 4}
 LIGHT = {"warmup": 1, "steps": 3, "trials": 1, "dtype": "float32",
-         "superstep_S": 2}
+         "superstep_S": 2, "grad_accum_G": 2}
 TENK = {"warmup": 2, "steps": 20, "trials": 2, "dtype": "bfloat16",
-        "superstep_S": 8}
+        "superstep_S": 8, "grad_accum_G": 4}
 
 TORCH_STEPS, TORCH_WARMUP = 10, 2
 
@@ -190,14 +197,37 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     # loss readback would leave the final step's parameter update outside
     # the timed region (~1% flattering at 100 steps/trial).
     sync_leaf = lambda s: float(jnp.ravel(jax.tree.leaves(s.params)[0])[0])
+
+    # HONEST-SYNC GUARD (schema v6): timed_trial is the ONLY way a trial
+    # gets timed, and it structurally ends in the updated-params readback
+    # before the clock stops; the ledger is asserted against at the end
+    # of the measurement so the round-2 dispatch-rate bug class (a timing
+    # loop "synced" with block_until_ready, which does not wait on the
+    # tunneled backend) cannot regress silently.
+    trial_ledger = {"started": 0, "synced": 0}
+
+    def timed_trial(run, state):
+        trial_ledger["started"] += 1
+        t0 = time.perf_counter()
+        state = run(state)
+        v = sync_leaf(state)                   # updated-params readback
+        elapsed = time.perf_counter() - t0
+        if not np.isfinite(v):
+            raise RuntimeError(f"non-finite params after timed trial ({v})")
+        trial_ledger["synced"] += 1
+        return elapsed, state
+
+    loss_box = {}
     best = 0.0
     for _ in range(sizes["trials"]):
-        t0 = time.perf_counter()
-        for _ in range(sizes["steps"]):
-            state, loss = trainer._train_step(state, x_d, y_d, w_d)
-        _ = sync_leaf(state)                       # sync: host readback
-        best = max(best, sizes["steps"] / (time.perf_counter() - t0))
-    lv = float(loss)
+        def run_steps(st):
+            for _ in range(sizes["steps"]):
+                st, loss_box["loss"] = trainer._train_step(st, x_d, y_d, w_d)
+            return st
+
+        elapsed, state = timed_trial(run_steps, state)
+        best = max(best, sizes["steps"] / elapsed)
+    lv = float(loss_box["loss"])
     if not np.isfinite(lv):
         raise RuntimeError(f"non-finite bench loss {lv}")
 
@@ -228,12 +258,15 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
         state, loss = trainer._train_step_indexed(
             state, x_base, y_base, starts_pool[i], w)
     _ = sync_leaf(state)
-    t0 = time.perf_counter()
-    for i in range(host_steps):
-        state, loss = trainer._train_step_indexed(
-            state, x_base, y_base, starts_pool[2 + i], w)
-    _ = sync_leaf(state)
-    indexed_sps = host_steps / (time.perf_counter() - t0)
+
+    def run_indexed(st):
+        for i in range(host_steps):
+            st, _l = trainer._train_step_indexed(
+                st, x_base, y_base, starts_pool[2 + i], w)
+        return st
+
+    elapsed, state = timed_trial(run_indexed, state)
+    indexed_sps = host_steps / elapsed
 
     # Fused superstep path (train_epoch's dispatch-amortized driver,
     # schema v3 key): the SAME staged base series, but S steps scanned
@@ -250,25 +283,79 @@ def measure_main(light: bool, cpu: bool = False, tenk: bool = False) -> None:
     state, _ss = trainer._superstep(state, x_base, y_base,
                                     sp_d, wp_d, 0)       # compile + warm
     _ = sync_leaf(state)
-    t0 = time.perf_counter()
-    for c in range(1, ss_chunks + 1):
-        state, _ss = trainer._superstep(state, x_base, y_base, sp_d, wp_d, c)
-    _ = sync_leaf(state)
-    superstep_sps = ss_chunks * S / (time.perf_counter() - t0)
+
+    def run_superstep(st):
+        for c in range(1, ss_chunks + 1):
+            st, _l = trainer._superstep(st, x_base, y_base, sp_d, wp_d, c)
+        return st
+
+    elapsed, state = timed_trial(run_superstep, state)
+    superstep_sps = ss_chunks * S / elapsed
+
+    # Window-coalesced superstep (schema v6): G consecutive plan steps
+    # fuse into ONE optimizer update whose recurrence sees G·B rows per
+    # matmul (TrainConfig.grad_accum_windows, PERF.md round 11) — the
+    # direct attack on the flagship's ~12% MXU row occupancy.  A second
+    # Trainer is needed because G is a plan-shape static; a failure here
+    # degrades to an error record, never sinks the headline.
+    accum_g = sizes["grad_accum_G"]
+    coalesced_sps = coalesced_err = None
+    try:
+        import dataclasses as _dc
+
+        cfg_c = cfg.replace(
+            train=_dc.replace(cfg.train, grad_accum_windows=accum_g))
+        trainer_c = Trainer(cfg_c, feat, metric_names)
+        state_c = trainer_c.init_state(x)
+        s_c = max(accum_g, (S // accum_g) * accum_g)
+        plan_c = (ss_chunks + 1, s_c, B)
+        sp_c = jnp.asarray(rng.integers(0, base_len - T,
+                                        size=plan_c).astype(np.int32))
+        wp_c = jnp.asarray(np.ones(plan_c, np.float32))
+        state_c, _ = trainer_c._accum_superstep(state_c, x_base, y_base,
+                                                sp_c, wp_c, 0)   # compile
+        _ = sync_leaf(state_c)
+
+        def run_coalesced(st):
+            for c in range(1, ss_chunks + 1):
+                st, _l = trainer_c._accum_superstep(st, x_base, y_base,
+                                                    sp_c, wp_c, c)
+            return st
+
+        elapsed, state_c = timed_trial(run_coalesced, state_c)
+        coalesced_sps = ss_chunks * s_c / elapsed     # microbatch steps/s
+    except Exception as exc:
+        coalesced_err = str(exc)[:200]
+        print(f"bench: coalesced measurement failed: {coalesced_err}",
+              file=sys.stderr)
+        # An aborted trial produced no rate — drop it from the ledger so
+        # the closing assertion still guards every REPORTED number.
+        trial_ledger["started"] = trial_ledger["synced"]
 
     # Historical host-feed path: fresh numpy window tensors shipped
     # host->device every step (what a corpus too big to stage pays).
-    t0 = time.perf_counter()
-    for _ in range(host_steps):
-        state, loss = trainer._train_step(state, x, y, w)
-    _ = sync_leaf(state)
-    host_sps = host_steps / (time.perf_counter() - t0)
+    def run_host_feed(st):
+        for _ in range(host_steps):
+            st, _l = trainer._train_step(st, x, y, w)
+        return st
+
+    elapsed, state = timed_trial(run_host_feed, state)
+    host_sps = host_steps / elapsed
+    # Every timed trial closed with its updated-params readback — the
+    # honest-sync assertion the v6 schema promises.
+    expected_trials = sizes["trials"] + 3 + (coalesced_sps is not None)
+    assert (trial_ledger["started"] == trial_ledger["synced"]
+            == expected_trials), (trial_ledger, expected_trials)
     dev = jax.devices()[0]
     out = {
         "steps_per_sec": best,
         "indexed_feed_steps_per_sec": indexed_sps,
         "superstep_steps_per_sec": superstep_sps,
         "superstep_S": S,
+        **({"coalesced_steps_per_sec": coalesced_sps,
+            "grad_accum_G": accum_g,
+            "recurrence_rows": accum_g * B} if coalesced_sps is not None
+           else {"coalesced_error": coalesced_err}),
         "host_feed_steps_per_sec": host_sps,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
@@ -458,6 +545,19 @@ def _mfu_block(measured: dict, features: int) -> dict:
         block["superstep_steps_per_sec"] = round(
             float(measured["superstep_steps_per_sec"]), 3)
         block["superstep_S"] = measured.get("superstep_S")
+    if measured.get("coalesced_steps_per_sec") is not None:
+        # Window-coalesced superstep (schema v6, NEW keys): G plan steps
+        # per optimizer update, recurrence matmuls at G·B rows
+        # (TrainConfig.grad_accum_windows; benchmarks/kernel_tuning.py
+        # --coalesce has the recurrence-isolated G sweep).  Rate is in
+        # MICROBATCH steps/s — directly comparable to
+        # superstep_steps_per_sec at the same shape.
+        block["coalesced_steps_per_sec"] = round(
+            float(measured["coalesced_steps_per_sec"]), 3)
+        block["grad_accum_G"] = measured.get("grad_accum_G")
+        block["recurrence_rows"] = measured.get("recurrence_rows")
+    elif "coalesced_error" in measured:
+        block["coalesced_error"] = measured["coalesced_error"]
     if "host_feed_steps_per_sec" in measured:
         block["host_feed_steps_per_sec"] = round(
             float(measured["host_feed_steps_per_sec"]), 3)
@@ -571,6 +671,13 @@ def main() -> None:
 
     perf = _mfu_block(measured, F)
     result = {
+        # v6: coalesced_steps_per_sec (+ grad_accum_G, recurrence_rows) is
+        # the window-coalesced superstep — G plan steps fused into one
+        # optimizer update with G·B recurrence rows per matmul — and every
+        # timed trial is now ASSERTED to end in an updated-params readback
+        # (the honest-sync ledger in measure_main), so the round-2
+        # dispatch-rate bug class cannot regress silently.  NEW keys only;
+        # every v5 key keeps its meaning.
         # v5: rolled_windows_per_sec is the fused rolled-inference serving
         # headline — a NEW key, nothing repurposed; every v4 key keeps its
         # meaning.
@@ -583,7 +690,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 5,
+        "schema_version": 6,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "

@@ -63,7 +63,14 @@ else
   step accuracy 14400 python benchmarks/accuracy_dossier.py \
     --features benchmarks/data/month_10k_features.npz --epochs 12
 fi
-step kernel_tuning 1800 python benchmarks/kernel_tuning.py --out benchmarks/kernel_tuning_r5.json
+# --coalesce (round 11): the window-coalescing G sweep at production
+# bf16 — G in {1,2,4,8} window batches folded into the recurrence's row
+# axis, x LOOP_ORDER x STASH_GATES — plus the VMEM block-plan table and
+# the fused-vs-unfused bidirectional record (the revert is already
+# executed, ops/gru.py BIDIR_FUSED=0; re-open with
+# DEEPREST_GRU_BIDIR_FUSED=1 if this sweep says otherwise on-chip).
+step kernel_tuning 2700 python benchmarks/kernel_tuning.py --coalesce \
+  --out benchmarks/kernel_tuning_r11.json
 step superstep_sweep 1800 python benchmarks/superstep_sweep.py --flagship \
   --out benchmarks/superstep_sweep_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship

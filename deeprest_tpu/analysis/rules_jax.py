@@ -256,11 +256,25 @@ class JX003ReadbackInHotLoop(Rule):
               "this rule keeps new ones out")
 
     # Modules where a per-iteration sync is a measured pipeline stall.
-    HOT_SUFFIXES = ("train/trainer.py", "serve/fused.py", "serve/batcher.py")
+    # Round 11 widened the watchlist from three named files to WHOLE
+    # package directories: the coalesced recurrence paths put hot device
+    # loops across ops/ and serve/, and a new module under either would
+    # silently dodge a name list (the issue's exact ask).  Host-side ETL
+    # (data/, workload/) stays exempt — numpy there is the design.
+    HOT_SUFFIXES = ("train/trainer.py",)
+    HOT_DIRS = ("ops", "serve")
+
+    def _is_hot(self, rel: str) -> bool:
+        # rel is lint-root-relative ("serve/predictor.py" when linting the
+        # package dir, "deeprest_tpu/serve/predictor.py" from a repo
+        # root), so match DIRECTORY COMPONENTS, not string prefixes.
+        parts = rel.replace("\\", "/").split("/")
+        return (rel.endswith(self.HOT_SUFFIXES)
+                or any(d in parts[:-1] for d in self.HOT_DIRS))
 
     def run(self, project: Project) -> Iterator[Finding]:
         for sf in project.files:
-            if sf.tree is None or not sf.rel.endswith(self.HOT_SUFFIXES):
+            if sf.tree is None or not self._is_hot(sf.rel):
                 continue
             for node in ast.walk(sf.tree):
                 if not isinstance(node, ast.Call):

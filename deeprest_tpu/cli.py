@@ -81,6 +81,13 @@ def _add_fused_infer_args(p: argparse.ArgumentParser):
                         "when off-ladder; default auto: cache-sized small "
                         "pages on CPU, the ladder's top rung on "
                         "accelerators)")
+    p.add_argument("--infer-coalesce-pages", type=int, default=None,
+                   metavar="G",
+                   help="fold up to G consecutive fused-inference pages "
+                        "into one dispatch so multi-series/what-if work "
+                        "fills page*G recurrence rows (adds super-rungs; "
+                        "default auto: 1 on CPU — small pages are "
+                        "cache-bound faster there — 4 on accelerators)")
 
 
 def _superstep_arg(v: str):
@@ -268,7 +275,9 @@ def cmd_train(args) -> int:
                           eval_stride=args.window,
                           checkpoint_dir=args.ckpt_dir or "",
                           device_data=args.device_data,
-                          steps_per_superstep=args.steps_per_superstep),
+                          steps_per_superstep=args.steps_per_superstep,
+                          grad_accum_windows=args.grad_accum_windows,
+                          grad_accum_mode=args.grad_accum_mode),
         mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
@@ -412,7 +421,9 @@ def cmd_stream(args) -> int:
                           learning_rate=args.lr, seed=args.seed,
                           eval_stride=1, eval_max_cycles=args.eval_holdout,
                           log_every_steps=0,
-                          steps_per_superstep=args.steps_per_superstep),
+                          steps_per_superstep=args.steps_per_superstep,
+                          grad_accum_windows=args.grad_accum_windows,
+                          grad_accum_mode=args.grad_accum_mode),
         etl=EtlConfig(overlap=not args.no_etl_overlap,
                       queue_depth=args.etl_queue_depth),
     )
@@ -468,7 +479,8 @@ def cmd_whatif(args) -> int:
 
     pred = Predictor.from_checkpoint(
         args.ckpt_dir, fused=not args.no_fused_infer,
-        page_windows=args.infer_page_windows)
+        page_windows=args.infer_page_windows,
+        coalesce_pages=args.infer_coalesce_pages)
     space = pred.space()
     if space is None:
         sys.exit("error: checkpoint has no feature space; cannot fit the "
@@ -546,11 +558,15 @@ def cmd_serve(args) -> int:
     if not ladder or min(ladder) < 1:
         sys.exit(f"error: --batch-ladder {args.batch_ladder!r}: rungs must "
                  "be >= 1")
+    if args.batch_coalesce_groups < 1:
+        sys.exit(f"error: --batch-coalesce-groups "
+                 f"{args.batch_coalesce_groups} must be >= 1")
     batching = None
     if not args.no_batcher:
-        if args.batch_max_windows > max(ladder):
+        top = max(ladder) * args.batch_coalesce_groups
+        if args.batch_max_windows > top:
             sys.exit(f"error: --batch-max-windows {args.batch_max_windows} "
-                     f"exceeds the top ladder rung {max(ladder)}")
+                     f"exceeds the top (coalesced) ladder rung {top}")
         batching = BatcherConfig(max_batch=args.batch_max_windows,
                                  max_linger_s=args.batch_linger_ms / 1e3)
     if args.watch and not args.ckpt_dir:
@@ -567,14 +583,17 @@ def cmd_serve(args) -> int:
             # writes while we load would otherwise be recorded as already
             # served and never reloaded. Worst case of this ordering is one
             # redundant reload of the step we are about to serve anyway.
-            reloader = CheckpointReloader(args.ckpt_dir,
-                                          min_interval_s=args.watch,
-                                          ladder=ladder,
-                                          fused=not args.no_fused_infer,
-                                          page_windows=args.infer_page_windows)
+            reloader = CheckpointReloader(
+                args.ckpt_dir, min_interval_s=args.watch, ladder=ladder,
+                fused=not args.no_fused_infer,
+                page_windows=args.infer_page_windows,
+                coalesce_pages=args.infer_coalesce_pages,
+                coalesce_groups=args.batch_coalesce_groups)
         pred = Predictor.from_checkpoint(
             args.ckpt_dir, ladder=ladder, fused=not args.no_fused_infer,
-            page_windows=args.infer_page_windows)
+            page_windows=args.infer_page_windows,
+            coalesce_pages=args.infer_coalesce_pages,
+            coalesce_groups=args.batch_coalesce_groups)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
             backend += " (watching)"
@@ -583,7 +602,9 @@ def cmd_serve(args) -> int:
 
         pred = ExportedPredictor.load(
             args.artifact, ladder=ladder, fused=not args.no_fused_infer,
-            page_windows=args.infer_page_windows)
+            page_windows=args.infer_page_windows,
+            coalesce_pages=args.infer_coalesce_pages,
+            coalesce_groups=args.batch_coalesce_groups)
         backend = f"artifact:{args.artifact}"
 
     synthesizer = None
@@ -626,7 +647,8 @@ def _predictor(args):
     return Predictor.from_checkpoint(
         args.ckpt_dir,
         fused=not getattr(args, "no_fused_infer", False),
-        page_windows=getattr(args, "infer_page_windows", None))
+        page_windows=getattr(args, "infer_page_windows", None),
+        coalesce_pages=getattr(args, "infer_coalesce_pages", None))
 
 
 def _serving_traffic(args, pred) -> np.ndarray:
@@ -865,6 +887,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "lax.scan on the staged path (1 = per-step loop; "
                         "'epoch' = whole epoch per dispatch; 'auto' sizes "
                         "from the logging cadence)")
+    p.add_argument("--grad-accum-windows", type=int, default=1, metavar="G",
+                   help="window-coalesced gradient accumulation on the "
+                        "staged superstep path: fold G consecutive "
+                        "microbatches into one fused forward/backward "
+                        "(G*batch-size recurrence rows per matmul) with "
+                        "one optimizer update per G on summed grads; "
+                        "requires the device-resident feed "
+                        "(--device-data always on CPU); 1 = per-step "
+                        "updates (default)")
+    p.add_argument("--grad-accum-mode", default="exact",
+                   choices=("exact", "flat", "loop"),
+                   help="how the G microbatches fuse: 'exact' (default) "
+                        "is bit-identical to the unfused accumulation "
+                        "loop; 'flat' folds rows straight through the "
+                        "kernel (max MXU row occupancy, ~1e-7 grad "
+                        "reassociation); 'loop' is the unfused reference")
     p.add_argument("--mesh", default=None, metavar="D,E,M",
                    help="device mesh data,expert,model (default 1,1,1; "
                         "multi-host joins via JAX_COORDINATOR_ADDRESS / "
@@ -925,6 +963,22 @@ def build_parser() -> argparse.ArgumentParser:
                    default="auto", metavar="N|auto|epoch",
                    help="fused steps per compiled dispatch for the staged "
                         "fine-tune epochs (1 = per-step loop)")
+    p.add_argument("--grad-accum-windows", type=int, default=1, metavar="G",
+                   help="window-coalesced gradient accumulation on the "
+                        "staged superstep path: fold G consecutive "
+                        "microbatches into one fused forward/backward "
+                        "(G*batch-size recurrence rows per matmul) with "
+                        "one optimizer update per G on summed grads; "
+                        "requires the device-resident feed "
+                        "(--device-data always on CPU); 1 = per-step "
+                        "updates (default)")
+    p.add_argument("--grad-accum-mode", default="exact",
+                   choices=("exact", "flat", "loop"),
+                   help="how the G microbatches fuse: 'exact' (default) "
+                        "is bit-identical to the unfused accumulation "
+                        "loop; 'flat' folds rows straight through the "
+                        "kernel (max MXU row occupancy, ~1e-7 grad "
+                        "reassociation); 'loop' is the unfused reference")
     p.add_argument("--refresh-buckets", type=int, default=60,
                    help="fine-tune after this many new buckets")
     p.add_argument("--finetune-epochs", type=int, default=2)
@@ -1011,6 +1065,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated window-count rungs every device "
                         "batch is padded up to (bounds the jit cache to "
                         "one executable per rung)")
+    p.add_argument("--batch-coalesce-groups", type=int, default=1,
+                   metavar="G",
+                   help="extend the ladder with top-rung*{2..G} "
+                        "super-rungs so a deep cross-request backlog "
+                        "dispatches one batch of top*G windows (G*64 "
+                        "recurrence rows at the default ladder) instead "
+                        "of G sequential top-rung dispatches; raise "
+                        "--batch-max-windows to match")
     _add_fused_infer_args(p)
     p.set_defaults(fn=cmd_serve)
 

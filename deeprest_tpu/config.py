@@ -115,6 +115,34 @@ class TrainConfig:
     # Ignored when the dataset is not staged (host-feed fallback keeps the
     # per-step loop).
     steps_per_superstep: int | str = "auto"
+    # Window-coalesced gradient accumulation on the staged superstep path:
+    # G consecutive plan steps (microbatches) fold into ONE fused
+    # forward/backward whose recurrence sees G·B rows per matmul — G× the
+    # MXU row occupancy of the latency-bound [32,128]×[128,384] per-step
+    # dot (PERF.md round 11) — and the optimizer update applies once per G
+    # with summed grads.  Groups share the weights, so the fold is
+    # algebraically free (unlike the rejected expert fold).  1 = the
+    # historical per-step update (default; the G>1 paths are new code,
+    # never silently entered).  Requires the staged (device-resident)
+    # feed; per-microbatch losses keep their meaning and the step counter
+    # still counts real microbatches.
+    grad_accum_windows: int = 1
+    # How the G microbatches are fused (ignored at G=1):
+    #   "exact" (default) — per-microbatch grads via jax.vmap with the
+    #     mask fold staged through an explicit jax.vjp prologue, summed in
+    #     microbatch order: bit-identical losses AND params to the
+    #     unfused accumulation loop (pinned by tests/test_coalesce.py).
+    #     XLA flattens the shared-weight dots to G·B rows.
+    #   "flat" — the G batches reshape into one [G·B] row batch through
+    #     the model's group axis: maximum kernel-level row occupancy (the
+    #     pallas recurrence sees G·B rows directly), per-microbatch
+    #     losses still bit-exact, but weight-grad contractions
+    #     re-associate across groups (~1e-7 relative on f32 — measured,
+    #     documented in PERF.md; same class as the fused-inference delta
+    #     tolerance).
+    #   "loop" — G sequential unfused passes, summed grads: the pinned
+    #     reference the other two are measured against.
+    grad_accum_mode: str = "exact"
 
     def __post_init__(self):
         v = self.steps_per_superstep
@@ -124,6 +152,14 @@ class TrainConfig:
             raise ValueError(
                 f"TrainConfig.steps_per_superstep={v!r}: must be 'auto', "
                 f"'epoch', or an int >= 1")
+        g = self.grad_accum_windows
+        if not isinstance(g, int) or isinstance(g, bool) or g < 1:
+            raise ValueError(
+                f"TrainConfig.grad_accum_windows={g!r}: must be an int >= 1")
+        if self.grad_accum_mode not in ("exact", "flat", "loop"):
+            raise ValueError(
+                f"TrainConfig.grad_accum_mode={self.grad_accum_mode!r}: "
+                f"must be 'exact', 'flat', or 'loop'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,12 +229,26 @@ class InferConfig:
 
     fused: bool = True
     page_windows: int | None = None
+    # Multi-series/multi-scenario page coalescing (serve/fused.py): fold up
+    # to this many consecutive pages of the window plan into ONE dispatch,
+    # so a rung-64 page becomes a 64·G-row batch that actually fills MXU
+    # rows instead of paging thin.  The carry/segment machinery already
+    # expresses any fold in one batch, so this only widens dispatches (new
+    # super-rungs page·{2..G} join the jit ladder).  None = backend auto:
+    # 1 on the CPU backend (the per-window cost there is cache-bound and
+    # MINIMIZED at small pages — PERF.md "rolled inference"), 4 on
+    # accelerators (256 recurrence rows at the default ladder).
+    coalesce_pages: int | None = None
 
     def __post_init__(self):
         if self.page_windows is not None and self.page_windows < 1:
             raise ValueError(
                 f"InferConfig.page_windows={self.page_windows}: must be "
                 ">= 1 (or None for the ladder's top rung)")
+        if self.coalesce_pages is not None and self.coalesce_pages < 1:
+            raise ValueError(
+                f"InferConfig.coalesce_pages={self.coalesce_pages}: must "
+                "be >= 1 (or None for the backend default)")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,6 +25,25 @@ TPU-first re-design (not a translation):
 Deviation (documented): for ``num_metrics == 1`` the reference's mean over
 the empty "others" set is undefined (it would crash); here the mix input
 falls back to the expert's own output.
+
+Coalescing plumbing (round 11): the window-coalesced trainer and the fused
+serving engine both fold G independent window batches into the batch (row)
+axis of ONE recurrence call.  Two hooks support that here:
+
+- **Group axis**: ``__call__`` accepts ``[G, B, T, F]`` and flattens the
+  group axis into the rows (``[G·B, T, F]``) around the shared pipeline —
+  every op is row-independent, so each group's slice of the output is
+  bit-identical to a standalone ``[B, T, F]`` call.
+- **External mask fold**: :func:`feature_mask` / :func:`fold_feature_mask`
+  lift the soft-mask computation and its fold into the layer-0 input
+  weights out of the module (single source — ``__call__`` calls the same
+  functions), and ``mask_folded=True`` tells ``__call__`` the caller
+  already folded.  The coalesced trainer's exact-gradient mode needs
+  this: the mask subgraph is params-only, so under ``jax.vmap`` its
+  backward would otherwise run ONCE on a pre-summed cotangent (different
+  float association than the per-microbatch loop it must match
+  bit-for-bit); staging it through an explicit ``jax.vjp`` keeps the
+  mask backward per-group and unbatched, exactly like the loop.
 """
 
 from __future__ import annotations
@@ -35,6 +54,45 @@ import jax.numpy as jnp
 
 from deeprest_tpu.config import ModelConfig
 from deeprest_tpu.ops.gru import GRUParams, bidirectional_gru, gru
+
+MASK_PARAM_NAMES = ("mask_w1", "mask_b1", "mask_w2", "mask_b2")
+# Layer-0 input weights the soft mask folds into ((x ⊙ m) @ W ≡ x @ (m ⊙ W));
+# only the keys present in the params tree apply (bwd exists iff bidirectional).
+MASKED_PARAM_NAMES = ("gru_fwd_w_ih", "gru_bwd_w_ih")
+
+
+def feature_mask(params) -> jax.Array:
+    """The learned soft feature mask ``[E, F]`` from the mask parameters.
+
+    Single source of the mask math: ``QuantileGRU.__call__`` routes through
+    this same function, so an externally computed mask (the coalesced
+    trainer's ``jax.vjp`` prologue) is bit-identical to the in-module one.
+    Mirrors the reference encoder: Linear(1→H) on a constant 1.0 input is
+    just (weight + bias), then ReLU → Linear(H→F) → softmax
+    (reference: resource-estimation/qrnn.py:20-26,33-36).
+    """
+    hidden_act = nn.relu(params["mask_w1"] + params["mask_b1"])     # [E, H]
+    logits = (jnp.einsum("eh,ehf->ef", hidden_act, params["mask_w2"])
+              + params["mask_b2"])
+    return jax.nn.softmax(logits, axis=-1)                          # [E, F]
+
+
+def fold_feature_mask(params):
+    """Fold the soft mask into the layer-0 input weights, tree-level.
+
+    Returns a new params mapping where every ``MASKED_PARAM_NAMES`` leaf is
+    replaced by ``mask[:, :, None] * w_ih`` — exactly the fold
+    ``__call__`` applies internally (``(x ⊙ m) @ W ≡ x @ (m ⊙ W)``).
+    Apply the result with ``mask_folded=True``.  The coalesced trainer
+    stages this through ``jax.vjp`` so the mask/fold backward runs
+    per-microbatch and unbatched (see module docstring).
+    """
+    mask = feature_mask(params)
+    out = dict(params)
+    for name in MASKED_PARAM_NAMES:
+        if name in out:
+            out[name] = mask[:, :, None] * out[name]
+    return out
 
 
 class QuantileGRU(nn.Module):
@@ -47,12 +105,24 @@ class QuantileGRU(nn.Module):
     config: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, *, deterministic: bool = True,
+                 mask_folded: bool = False) -> jax.Array:
         cfg = self.config
         e, f, h, q = cfg.num_metrics, cfg.feature_dim, cfg.hidden_size, len(cfg.quantiles)
         if x.shape[-1] != f:
             raise ValueError(f"input feature dim {x.shape[-1]} != config.feature_dim {f}")
         compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+        # Group axis (coalescing plumbing): [G, B, T, F] folds its groups
+        # into the row axis for the whole pipeline — one fat recurrence
+        # call instead of G thin ones — and unfolds on the way out.  Every
+        # op maps rows independently, so each group's output slice is
+        # bit-identical to a standalone [B, T, F] call (pinned by
+        # tests/test_coalesce.py).
+        group_shape = None
+        if x.ndim == 4:
+            group_shape = x.shape[:2]
+            x = x.reshape(group_shape[0] * group_shape[1], *x.shape[2:])
 
         def uniform_pm(scale):
             def _init(key, shape, dtype=jnp.float32):
@@ -62,17 +132,22 @@ class QuantileGRU(nn.Module):
         # (a) learned soft feature mask — Linear(1→H) → ReLU → Linear(H→F)
         # → softmax, driven by a constant 1.0 (reference: qrnn.py:20-26,33-36).
         # Linear(1→H) on a constant input is just (weight + bias): one [E,H]
-        # pre-activation per expert.
+        # pre-activation per expert.  The math lives in the module-level
+        # feature_mask() so external callers (the coalesced trainer's vjp
+        # prologue) compute bit-identical values; with mask_folded=True the
+        # caller already folded it into the layer-0 weights and the mask
+        # subgraph is skipped entirely (its params then receive zero grads
+        # from this apply — the prologue vjp supplies them).
         k_in = 1.0  # fan_in of the constant input
-        mask_w1 = self.param("mask_w1", uniform_pm(1.0 / k_in ** 0.5), (e, h))
-        mask_b1 = self.param("mask_b1", uniform_pm(1.0 / k_in ** 0.5), (e, h))
+        mask_params = {
+            "mask_w1": self.param("mask_w1", uniform_pm(1.0 / k_in ** 0.5), (e, h)),
+            "mask_b1": self.param("mask_b1", uniform_pm(1.0 / k_in ** 0.5), (e, h)),
+        }
         k_h = 1.0 / h ** 0.5
-        mask_w2 = self.param("mask_w2", uniform_pm(k_h), (e, h, f))
-        mask_b2 = self.param("mask_b2", uniform_pm(k_h), (e, f))
+        mask_params["mask_w2"] = self.param("mask_w2", uniform_pm(k_h), (e, h, f))
+        mask_params["mask_b2"] = self.param("mask_b2", uniform_pm(k_h), (e, f))
 
-        hidden_act = nn.relu(mask_w1 + mask_b1)                      # [E, H]
-        logits = jnp.einsum("eh,ehf->ef", hidden_act, mask_w2) + mask_b2
-        mask = jax.nn.softmax(logits, axis=-1)                        # [E, F]
+        mask = None if mask_folded else feature_mask(mask_params)     # [E, F]
 
         # (b) (stacked) bidirectional GRU over the window (reference:
         # qrnn.py:24,39-43; layer l>0 consumes layer l-1's output, matching
@@ -88,7 +163,10 @@ class QuantileGRU(nn.Module):
             )
 
         # Fold the mask into the input weights: (x ⊙ m) @ W == x @ (m ⊙ W).
+        # Identity when the caller pre-folded (fold_feature_mask).
         def masked(p: GRUParams) -> GRUParams:
+            if mask is None:
+                return p
             return p._replace(w_ih=mask[:, :, None] * p.w_ih)
 
         def cast(p: GRUParams) -> GRUParams:
@@ -149,7 +227,10 @@ class QuantileGRU(nn.Module):
                  + jnp.einsum("ebtd,edq->ebtq", rnn_out, hw[:, d:],
                               preferred_element_type=jnp.float32))
         preds = preds + head_b[:, None, None, :]
-        return jnp.transpose(preds, (1, 2, 0, 3))                     # [B,T,E,Q]
+        preds = jnp.transpose(preds, (1, 2, 0, 3))                    # [B,T,E,Q]
+        if group_shape is not None:
+            preds = preds.reshape(*group_shape, *preds.shape[1:])     # [G,B,T,E,Q]
+        return preds
 
     # ------------------------------------------------------------------
     @property

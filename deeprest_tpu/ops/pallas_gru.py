@@ -229,23 +229,31 @@ def _out_dtype_for(proj_dtype):
     return jnp.bfloat16 if proj_dtype == jnp.bfloat16 else jnp.float32
 
 
-def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
-    e, t, b, g3 = proj.shape
-    h = g3 // 3
-    assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
-    io = proj.dtype.itemsize
-    out_dtype = _out_dtype_for(proj.dtype)
-    oo = jnp.dtype(out_dtype).itemsize
-    stash = emit_prev and STASH_GATES
-    n_h_out = 2 if emit_prev else 1
-    per_expert = lambda t_blk: (
+def _fwd_per_expert_bytes(b, g3, h, proj_dtype, stash, n_h_out,
+                          w_itemsize, h0_itemsize):
+    """Forward-kernel VMEM bytes per expert as a function of t_blk — the
+    single source for _choose_blocks AND the public block_plan probe."""
+    io = jnp.dtype(proj_dtype).itemsize
+    oo = jnp.dtype(_out_dtype_for(proj_dtype)).itemsize
+    return lambda t_blk: (
         # proj in + h out (+ prev out and gates out when training),
         # double-buffered
         2 * (t_blk * b * g3 * io + n_h_out * t_blk * b * h * oo
              + (t_blk * b * g3 * io if stash else 0))
-        + h * g3 * w_hh.dtype.itemsize + g3 * 4          # W_hh, b_hh resident
-        + b * h * h0.dtype.itemsize + b * h * 4          # h0 block + scratch
+        + h * g3 * w_itemsize + g3 * 4                   # W_hh, b_hh resident
+        + b * h * h0_itemsize + b * h * 4                # h0 block + scratch
     )
+
+
+def _fwd_call(proj, w_hh, b_hh, h0, interpret, emit_prev=False):
+    e, t, b, g3 = proj.shape
+    h = g3 // 3
+    assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
+    out_dtype = _out_dtype_for(proj.dtype)
+    stash = emit_prev and STASH_GATES
+    n_h_out = 2 if emit_prev else 1
+    per_expert = _fwd_per_expert_bytes(b, g3, h, proj.dtype, stash, n_h_out,
+                                       w_hh.dtype.itemsize, h0.dtype.itemsize)
     e_blk, t_blk = _choose_blocks(e, t, per_expert)
     eb = e // e_blk
     grid = (eb, t // t_blk)
@@ -388,16 +396,13 @@ def _bwd_kernel(proj_ref, hprev_ref, *refs, dot_dtype, stash_gates,
         dh0_ref[...] = dh_scr[...]
 
 
-def _bwd_call(proj, h_prev_all, gates_all, w_hh, b_hh, dout, interpret):
-    e, t, b, g3 = proj.shape
-    h = g3 // 3
-    assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
-    io = proj.dtype.itemsize
-    dot_io = jnp.dtype(_dot_dtype_for(proj.dtype)).itemsize
-    hp_io = h_prev_all.dtype.itemsize
-    do_io = dout.dtype.itemsize
-    stash = gates_all is not None
-    per_expert = lambda t_blk: (
+def _bwd_per_expert_bytes(b, g3, h, proj_dtype, stash, hp_io, do_io,
+                          w_itemsize):
+    """Backward-kernel VMEM bytes per expert as a function of t_blk — the
+    single source for _choose_blocks AND the public block_plan probe."""
+    io = jnp.dtype(proj_dtype).itemsize
+    dot_io = jnp.dtype(_dot_dtype_for(proj_dtype)).itemsize
+    return lambda t_blk: (
         # time-grid blocks, double-buffered: proj, h_prev, dout (and the
         # stashed gates when present) in; dproj out (h_prev/dout ride the
         # model's out dtype — _vjp_bwd)
@@ -406,11 +411,21 @@ def _bwd_call(proj, h_prev_all, gates_all, w_hh, b_hh, dout, interpret):
              + (t_blk * b * g3 * io if stash else 0))
         # resident: W_hh + b_hh in, dW/db/dh0 out, dh/dW/db scratch,
         # dgates stash (dot dtype) for the block-batched dW dot
-        + h * g3 * w_hh.dtype.itemsize + g3 * 4
+        + h * g3 * w_itemsize + g3 * 4
         + h * g3 * 4 + g3 * 4 + b * h * 4
         + b * h * 4 + h * g3 * 4 + g3 * 4
         + t_blk * b * g3 * dot_io
     )
+
+
+def _bwd_call(proj, h_prev_all, gates_all, w_hh, b_hh, dout, interpret):
+    e, t, b, g3 = proj.shape
+    h = g3 // 3
+    assert t % T_BLK == 0, (t, T_BLK)   # callers pad_time first
+    stash = gates_all is not None
+    per_expert = _bwd_per_expert_bytes(
+        b, g3, h, proj.dtype, stash, h_prev_all.dtype.itemsize,
+        dout.dtype.itemsize, w_hh.dtype.itemsize)
     e_blk, t_blk = _choose_blocks(e, t, per_expert)
     eb = e // e_blk
     nb = t // t_blk
@@ -547,3 +562,60 @@ def pad_time(t: int) -> int:
 def supported(t: int, h: int) -> bool:
     """Kernel preconditions: lane-aligned hidden size, non-trivial window."""
     return h % 128 == 0 and t >= 1
+
+
+def block_plan(e: int, t: int, b: int, h: int, dtype=jnp.float32,
+               training: bool = True) -> dict:
+    """Predict the (e_blk, t_blk) blocking and scoped-VMEM fit at a shape.
+
+    The round-11 window coalescing fattens the kernels' B (row) axis by
+    G× — the VMEM footprint model that sizes blocks (_choose_blocks) was
+    built at B=32 and is re-validated here at the fatter row counts:
+    callers (tests/test_coalesce.py, benchmarks/kernel_tuning.py
+    ``--coalesce``) probe the EXACT per-expert byte model the kernel calls
+    use (shared _fwd/_bwd_per_expert_bytes) without compiling anything.
+
+    ``dtype`` is the kernel I/O (proj) dtype — bf16 for bf16 models, f32
+    otherwise (ops/gru.py ``_kernel_io_dtype``); ``b`` is the PRE-padding
+    row count (``pad_batch`` is applied here).  ``training=True`` reports
+    the tighter of the forward (emit_prev + gate stash) and backward
+    plans, since both kernels run under the custom VJP.
+
+    Returns ``{"e_blk", "t_blk", "per_expert_bytes", "block_bytes",
+    "fits", "b_padded", "t_padded", "budget"}`` for the binding kernel.
+    """
+    io_dtype = jnp.bfloat16 if jnp.dtype(dtype) == jnp.bfloat16 \
+        else jnp.float32
+    b_pad = pad_batch(b, io_dtype)
+    t_pad = pad_time(t)
+    g3 = 3 * h
+    w_itemsize = jnp.dtype(io_dtype).itemsize
+    out_io = jnp.dtype(_out_dtype_for(io_dtype)).itemsize
+    plans = []
+    fwd_pe = _fwd_per_expert_bytes(
+        b_pad, g3, h, io_dtype, stash=training and STASH_GATES,
+        n_h_out=2 if training else 1, w_itemsize=w_itemsize, h0_itemsize=4)
+    plans.append(("fwd", fwd_pe))
+    if training:
+        bwd_pe = _bwd_per_expert_bytes(
+            b_pad, g3, h, io_dtype, stash=STASH_GATES, hp_io=out_io,
+            do_io=out_io, w_itemsize=w_itemsize)
+        plans.append(("bwd", bwd_pe))
+    worst = None
+    import warnings
+
+    for _name, per_expert in plans:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # probe, not a compile site
+            e_blk, t_blk = _choose_blocks(e, t_pad, per_expert)
+        block_bytes = e_blk * per_expert(t_blk)
+        entry = {
+            "e_blk": e_blk, "t_blk": t_blk,
+            "per_expert_bytes": per_expert(t_blk),
+            "block_bytes": block_bytes,
+            "fits": block_bytes <= _VMEM_BUDGET,
+            "b_padded": b_pad, "t_padded": t_pad, "budget": _VMEM_BUDGET,
+        }
+        if worst is None or entry["block_bytes"] > worst["block_bytes"]:
+            worst = entry
+    return worst

@@ -18,6 +18,7 @@ def pinball_loss(
     targets: jax.Array,
     quantiles: tuple[float, ...] | jax.Array,
     sample_weight: jax.Array | None = None,
+    allow_empty: bool = False,
 ) -> jax.Array:
     """Mean pinball loss.
 
@@ -29,6 +30,13 @@ def pinball_loss(
         weighted mean.  Used to pad ragged trailing batches up to a static
         shape with zero-weight duplicates while keeping the loss exactly
         the mean over real samples.
+      allow_empty: guard the weighted mean's denominator at 1 so an
+        all-zero-weight batch yields loss 0 (and exactly-zero gradients)
+        instead of 0/0 NaN.  Real batches have ``sum(weight) >= 1``, where
+        ``max(sum, 1)`` returns the identical float — bit-equal to the
+        unguarded loss (the window-coalesced trainer relies on this:
+        zero-weight pad microbatches inside a partially-real group must
+        contribute nothing without a per-microbatch cond branch).
 
     Returns: scalar loss,
       ``mean_E( mean_{B,T}( sum_Q max((q-1)·err, q·err) ) )``
@@ -42,7 +50,10 @@ def pinball_loss(
         per_metric = jnp.mean(per_sample, axis=(0, 1))
     else:
         w = sample_weight.astype(per_sample.dtype)[:, None, None]
+        den = jnp.sum(sample_weight)
+        if allow_empty:
+            den = jnp.maximum(den, jnp.ones((), den.dtype))
         per_metric = jnp.sum(per_sample * w, axis=(0, 1)) / (
-            jnp.sum(sample_weight) * per_sample.shape[1]
+            den * per_sample.shape[1]
         )
     return jnp.mean(per_metric)

@@ -144,6 +144,7 @@ class AnomalyDetector:
                     first = t - self.min_run + 1
             reports.append(AnomalyReport(
                 metric=metric,
+                # graftlint: disable=JX003 -- host data: `excess` was materialized to numpy before this loop; no device sync here
                 score=float(ex.mean()),
                 flagged=longest >= self.min_run,
                 first_flag_index=first,

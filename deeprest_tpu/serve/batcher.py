@@ -85,12 +85,25 @@ class ShapeLadder:
     ``__call__`` is the synchronous composition.
     """
 
-    def __init__(self, apply_fn, ladder=DEFAULT_LADDER):
-        rungs = tuple(sorted({int(r) for r in ladder}))
-        if not rungs or rungs[0] < 1:
+    def __init__(self, apply_fn, ladder=DEFAULT_LADDER,
+                 coalesce_groups: int = 1):
+        base = tuple(sorted({int(r) for r in ladder}))
+        if not base or base[0] < 1:
             raise ValueError(f"bad shape ladder {ladder!r}")
+        if coalesce_groups < 1:
+            raise ValueError(
+                f"coalesce_groups {coalesce_groups} must be >= 1")
         self._apply = apply_fn
-        self.ladder = rungs
+        self.base_ladder = base
+        self.coalesce_groups = int(coalesce_groups)
+        # Coalesced super-rungs (round 11): top·{2..G} join the ladder so
+        # a deep cross-request backlog dispatches ONE fat batch (top·G
+        # recurrence rows) instead of G sequential top-rung dispatches —
+        # the request-level face of the window-coalesced kernel batching.
+        # Each super-rung is one extra executable, same as any rung.
+        rungs = set(base)
+        rungs.update(base[-1] * g for g in range(2, self.coalesce_groups + 1))
+        self.ladder = tuple(sorted(rungs))
         self._lock = threading.Lock()
         self._compiled: set[int] = set()     # rungs dispatched at least once
         self._calls = 0
@@ -147,6 +160,7 @@ class ShapeLadder:
         with self._lock:
             return {
                 "ladder": list(self.ladder),
+                "coalesce_groups": self.coalesce_groups,
                 "calls": self._calls,
                 "windows": self._windows,
                 "padded_windows": self._padded_windows,
@@ -349,8 +363,10 @@ class BatchedBackendMixin:
     predict_series traffic (predict / what-if / anomaly) routes through.
     """
 
-    def _init_batching(self, apply_fn, ladder=None) -> None:
-        self.ladder = ShapeLadder(apply_fn, ladder or DEFAULT_LADDER)
+    def _init_batching(self, apply_fn, ladder=None,
+                       coalesce_groups: int = 1) -> None:
+        self.ladder = ShapeLadder(apply_fn, ladder or DEFAULT_LADDER,
+                                  coalesce_groups=coalesce_groups)
         self._batcher: MicroBatcher | None = None
 
     @property

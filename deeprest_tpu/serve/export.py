@@ -94,7 +94,9 @@ class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
     def __init__(self, exported: jexport.Exported, manifest: dict,
                  ladder: tuple[int, ...] | None = None,
                  fused: bool = True,
-                 page_windows: int | None = None):
+                 page_windows: int | None = None,
+                 coalesce_pages: int | None = None,
+                 coalesce_groups: int = 1):
         if manifest.get("format") != _FORMAT:
             raise ValueError(f"unknown artifact format {manifest.get('format')!r}")
         self._exported = exported
@@ -108,26 +110,31 @@ class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
         self.space_dict = manifest.get("space")
         dm = manifest.get("delta_mask")
         self.delta_mask = np.asarray(dm, bool) if dm is not None else None
-        self._init_batching(self._exported.call, ladder=ladder)
+        self._init_batching(self._exported.call, ladder=ladder,
+                            coalesce_groups=coalesce_groups)
         # Exported.call is traceable under jit, so the deserialized
         # StableHLO module composes into the same fused one-dispatch
         # pipeline the in-process Predictor uses (serve/fused.py).  The
         # artifact's weights are baked into the module; params stay ().
         self._init_fused(lambda _, x: self._exported.call(x),
-                         enabled=fused, page_windows=page_windows)
+                         enabled=fused, page_windows=page_windows,
+                         coalesce_pages=coalesce_pages)
 
     @classmethod
     def load(cls, directory: str,
              ladder: tuple[int, ...] | None = None,
              fused: bool = True,
-             page_windows: int | None = None) -> "ExportedPredictor":
+             page_windows: int | None = None,
+             coalesce_pages: int | None = None,
+             coalesce_groups: int = 1) -> "ExportedPredictor":
         with open(os.path.join(directory, ARTIFACT_MANIFEST),
                   encoding="utf-8") as f:
             manifest = json.load(f)
         with open(os.path.join(directory, ARTIFACT_BLOB), "rb") as f:
             exported = jexport.deserialize(f.read())
         return cls(exported, manifest, ladder=ladder, fused=fused,
-                   page_windows=page_windows)
+                   page_windows=page_windows, coalesce_pages=coalesce_pages,
+                   coalesce_groups=coalesce_groups)
 
     def jit_cache_size(self) -> int | None:
         """Fused-pipeline executable count (the artifact's own symbolic-

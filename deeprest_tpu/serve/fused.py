@@ -94,12 +94,22 @@ class FusedRolledEngine:
     which would break bit parity with the ladder path's standalone apply.
     """
 
+    # Accelerator default for coalesce_pages (InferConfig.coalesce_pages
+    # None): 4 consecutive pages of the window plan fold into one
+    # dispatch — 256 recurrence rows at the default rung-64 page, and the
+    # bf16 inference kernel's VMEM block plan still fits at that width
+    # (ops/pallas_gru.block_plan, re-validated round 11).  CPU stays at 1:
+    # the per-window cost there is cache-bound and MINIMIZED at small
+    # pages (PERF.md "rolled inference").
+    ACCEL_COALESCE_PAGES = 4
+
     def __init__(self, apply_fn, x_stats, y_stats, window_size: int,
                  params=(),
                  delta_mask: np.ndarray | None = None,
                  median_index: int | None = None,
                  rungs=DEFAULT_FUSED_RUNGS,
-                 page_windows: int | None = None):
+                 page_windows: int | None = None,
+                 coalesce_pages: int | None = None):
         import jax
 
         rung_set = {int(r) for r in rungs}
@@ -107,23 +117,39 @@ class FusedRolledEngine:
             if page_windows < 1:
                 raise ValueError(f"page_windows {page_windows} must be >= 1")
             rung_set.add(int(page_windows))
-        self.rungs = tuple(sorted(rung_set))
-        if not self.rungs or self.rungs[0] < 1:
-            raise ValueError(f"bad fused rung set {rungs!r}")
+        if coalesce_pages is None:
+            coalesce_pages = (1 if jax.default_backend() == "cpu"
+                              else self.ACCEL_COALESCE_PAGES)
+        if coalesce_pages < 1:
+            raise ValueError(f"coalesce_pages {coalesce_pages} must be >= 1")
+        self.coalesce_pages = int(coalesce_pages)
+        base_rungs = tuple(sorted(rung_set))
         if page_windows is not None:
-            self.page = int(page_windows)
+            page = int(page_windows)
         elif jax.default_backend() == "cpu":
             # Measured on XLA CPU (PERF.md "rolled inference"): GRU
             # per-window cost is MINIMIZED at small batch — the recurrence
             # state stays cache-resident — and grows ~2x by rung 32/64.
             # Page at the smallest rung >= 8 so pages stay in cache;
             # larger rungs still serve explicit overrides.
-            at_least_8 = [r for r in self.rungs if r >= 8]
-            self.page = at_least_8[0] if at_least_8 else self.rungs[-1]
+            at_least_8 = [r for r in base_rungs if r >= 8]
+            page = at_least_8[0] if at_least_8 else base_rungs[-1]
         else:
             # Accelerators want the widest batch the ladder offers (MXU
             # row occupancy; the CPU cache argument does not apply).
-            self.page = self.rungs[-1]
+            page = base_rungs[-1]
+        self.page = page
+        # Page coalescing (round 11): up to ``coalesce_pages`` consecutive
+        # pages of the window plan dispatch as ONE batch, so multi-series
+        # and multi-scenario folds fill page·G recurrence rows instead of
+        # paging thin.  The carry/segment-reset machinery already handles
+        # any fold inside one batch, so this adds only the super-rungs
+        # page·{2..G} to the jit ladder (one executable each, same as any
+        # rung) and widens the dispatch loop's stride.
+        rung_set.update(page * g for g in range(2, self.coalesce_pages + 1))
+        self.rungs = tuple(sorted(rung_set))
+        if not self.rungs or self.rungs[0] < 1:
+            raise ValueError(f"bad fused rung set {rungs!r}")
         self._apply_fn = apply_fn
         self._params = params
         self.window_size = int(window_size)
@@ -159,6 +185,7 @@ class FusedRolledEngine:
         self._windows = 0
         self._padded_windows = 0
         self._series = 0
+        self._max_dispatch_rows = 0
         self._compiled: set[int] = set()
 
     # -- device program -------------------------------------------------
@@ -244,7 +271,9 @@ class FusedRolledEngine:
             return []
         feat = arrays[0].shape[1]
         metas = plan_windows([len(a) for a in arrays], w)
-        page = self.page
+        # Coalesced dispatch stride: up to coalesce_pages pages per batch
+        # (the super-rungs are in self.rungs, so rung_for always fits).
+        page = self.page * self.coalesce_pages
         carry = self._carry0
         dispatched = []
         pages = padded = 0
@@ -271,6 +300,10 @@ class FusedRolledEngine:
             self._windows += len(metas)
             self._padded_windows += padded
             self._series += len(arrays)
+            if dispatched:
+                self._max_dispatch_rows = max(
+                    self._max_dispatch_rows,
+                    max(self.rung_for(len(c)) for _, c in dispatched))
             self._compiled.update(self.rung_for(len(c)) for _, c in dispatched)
 
         out_dims = None
@@ -302,10 +335,12 @@ class FusedRolledEngine:
             return {
                 "rungs": list(self.rungs),
                 "page_windows": self.page,
+                "coalesce_pages": self.coalesce_pages,
                 "pages": self._pages,
                 "windows": self._windows,
                 "padded_windows": self._padded_windows,
                 "series": self._series,
+                "max_dispatch_rows": self._max_dispatch_rows,
                 "dispatched_rungs": sorted(self._compiled),
             }
 
@@ -331,7 +366,8 @@ class FusedInferenceMixin:
     _fused: FusedRolledEngine | None = None
 
     def _init_fused(self, apply_fn, params=(), enabled: bool = True,
-                    page_windows: int | None = None) -> None:
+                    page_windows: int | None = None,
+                    coalesce_pages: int | None = None) -> None:
         if not enabled:
             self._fused = None
             return
@@ -339,7 +375,8 @@ class FusedInferenceMixin:
             apply_fn, self.x_stats, self.y_stats, self.window_size,
             params=params,
             delta_mask=self.delta_mask, median_index=self.median_index(),
-            rungs=self.ladder.ladder, page_windows=page_windows)
+            rungs=self.ladder.base_ladder, page_windows=page_windows,
+            coalesce_pages=coalesce_pages)
 
     @property
     def fused(self) -> FusedRolledEngine | None:

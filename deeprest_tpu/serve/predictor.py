@@ -80,6 +80,7 @@ def rolled_prediction_reference(
         chunk = starts[lo:lo + max_batch]
         x = np.stack([traffic[s:s + w] for s in chunk]).astype(np.float32)
         x = x_stats.apply(x).astype(np.float32)
+        # graftlint: disable=JX003 -- designed sink: the pinned HOST-LOOP reference reads every batch back by definition; the production path is the fused engine
         preds = np.asarray(apply_fn(x))                   # [n, W, E, Q]
         preds = y_stats.invert(
             np.maximum(preds, 1e-6).transpose(0, 1, 3, 2)
@@ -88,6 +89,7 @@ def rolled_prediction_reference(
             out = np.empty((t, preds.shape[2], preds.shape[3]), np.float32)
         for s, window in zip(chunk, preds):
             if delta_mask is not None and delta_mask.any():
+                # graftlint: disable=JX003 -- host data: `window` is a numpy slice of the already-read-back batch
                 window = np.array(window, copy=True)
                 c = np.cumsum(window[:, delta_mask, :], axis=0)
                 # carry: the already-written median level one step before
@@ -113,7 +115,9 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                  delta_mask: np.ndarray | None = None,
                  ladder: tuple[int, ...] | None = None,
                  fused: bool = True,
-                 page_windows: int | None = None):
+                 page_windows: int | None = None,
+                 coalesce_pages: int | None = None,
+                 coalesce_groups: int = 1):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -136,14 +140,15 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         # holds one executable per rung, never one per ragged shape.
         self._init_batching(
             lambda x: self._apply(self.params, jnp.asarray(x)),
-            ladder=ladder)
+            ladder=ladder, coalesce_groups=coalesce_groups)
         # The fused device-resident rolled-inference engine (serve/fused.py)
         # shares the ladder's rung set, so mixed series lengths compile at
         # most one fused executable per rung.  Params thread through the
         # fused jit as arguments (bit parity — see FusedRolledEngine).
         self._init_fused(
             lambda p, x: self._apply(p, x), params=self.params,
-            enabled=fused, page_windows=page_windows)
+            enabled=fused, page_windows=page_windows,
+            coalesce_pages=coalesce_pages)
 
     def jit_cache_size(self) -> int | None:
         """Total compiled-executable count across BOTH serving programs —
@@ -201,7 +206,9 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                         step: int | None = None,
                         ladder: tuple[int, ...] | None = None,
                         fused: bool = True,
-                        page_windows: int | None = None) -> "Predictor":
+                        page_windows: int | None = None,
+                        coalesce_pages: int | None = None,
+                        coalesce_groups: int = 1) -> "Predictor":
         """Restore params + host stats written by Trainer.save().
 
         With ``config=None`` the architecture comes wholesale from the
@@ -249,6 +256,8 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             ladder=ladder,
             fused=fused,
             page_windows=page_windows,
+            coalesce_pages=coalesce_pages,
+            coalesce_groups=coalesce_groups,
         )
 
     def space(self):

@@ -60,7 +60,9 @@ class CheckpointReloader:
 
     def __init__(self, ckpt_dir: str, min_interval_s: float = 2.0,
                  ladder: tuple[int, ...] | None = None,
-                 fused: bool = True, page_windows: int | None = None):
+                 fused: bool = True, page_windows: int | None = None,
+                 coalesce_pages: int | None = None,
+                 coalesce_groups: int = 1):
         from deeprest_tpu.train.checkpoint import latest_step
 
         self.ckpt_dir = ckpt_dir
@@ -68,6 +70,8 @@ class CheckpointReloader:
         self.ladder = ladder      # reloaded predictors keep the serving ladder
         self.fused = fused        # ... and the fused-inference config
         self.page_windows = page_windows
+        self.coalesce_pages = coalesce_pages
+        self.coalesce_groups = coalesce_groups
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
         self._pending = None       # loaded Predictor awaiting pickup
@@ -110,10 +114,11 @@ class CheckpointReloader:
 
         fresh = None
         try:
-            fresh = Predictor.from_checkpoint(self.ckpt_dir, step=step,
-                                              ladder=self.ladder,
-                                              fused=self.fused,
-                                              page_windows=self.page_windows)
+            fresh = Predictor.from_checkpoint(
+                self.ckpt_dir, step=step, ladder=self.ladder,
+                fused=self.fused, page_windows=self.page_windows,
+                coalesce_pages=self.coalesce_pages,
+                coalesce_groups=self.coalesce_groups)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/
             # ValueError); anything else is logged but must never wedge
@@ -313,6 +318,7 @@ class PredictionService:
             # level before treating values as absolute utilization.
             "relative_metrics": [
                 m for e, m in enumerate(pred.metric_names)
+                # graftlint: disable=JX003 -- host data: dm is the numpy delta mask, not a device array
                 if dm is not None and bool(dm[e])
             ],
         }

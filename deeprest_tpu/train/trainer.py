@@ -26,7 +26,7 @@ import numpy as np
 import optax
 
 from deeprest_tpu.config import Config
-from deeprest_tpu.models.qrnn import QuantileGRU
+from deeprest_tpu.models.qrnn import QuantileGRU, fold_feature_mask
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
     feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
@@ -176,6 +176,165 @@ class Trainer:
 
             return jax.lax.scan(body, state, (starts_c, weights_c))
 
+        # -- window-coalesced gradient accumulation (round 11) ---------
+        #
+        # G consecutive plan steps (microbatches) fold into ONE fused
+        # forward/backward — the recurrence's per-step dot sees G·B rows
+        # instead of B — and the optimizer update applies once per G with
+        # grads summed in microbatch order.  Three modes (TrainConfig.
+        # grad_accum_mode); "exact" is the default and is bit-identical
+        # to the unfused "loop" reference:
+        #
+        #   exact: per-microbatch value_and_grad under jax.vmap.  Two
+        #     subtleties make this BIT-equal to the loop: (1) the soft
+        #     feature mask is params-only, so under vmap its backward
+        #     would run once on a pre-summed cotangent (different float
+        #     association than per-microbatch backwards) — the mask fold
+        #     therefore stages through an explicit jax.vjp prologue
+        #     outside the vmap, and each microbatch's fold cotangent is
+        #     pushed through that unbatched vjp separately, in microbatch
+        #     order; (2) dropout draws per-microbatch fold_in(key, g)
+        #     streams, which jax.random reproduces bit-for-bit under
+        #     vmap.  XLA still flattens the shared-weight matmuls to G·B
+        #     rows (the RHS carries no group axis), so the fat-dot win
+        #     survives the exactness.
+        #   flat: the G batches reshape to one [G·B] row batch through
+        #     the model's group axis — the kernel-level row fold (the
+        #     pallas recurrence sees G·B rows directly).  Microbatch
+        #     LOSSES stay bit-exact (rows are independent); weight-grad
+        #     contractions re-associate across groups (~1e-7 relative on
+        #     f32, measured — PERF.md round 11), because one fma-chain
+        #     over G·B rows cannot reproduce "sum of per-group chains".
+        #   loop: G sequential unfused passes — the pinned reference.
+        #
+        # Zero-weight pad microbatches contribute exactly-zero grads
+        # (pinball_loss allow_empty guards the 0/0) so partially-padded
+        # trailing groups need no per-microbatch cond; a fully-padded
+        # group takes the update-level cond skip.  The step counter keeps
+        # counting REAL microbatches, and the per-update dropout key is
+        # fold_in(rng, step)-then-fold_in(·, g) — a stream of its own
+        # (grad accumulation is a different training algorithm; it is
+        # pinned against its OWN loop reference, not against G=1).
+        accum_g = int(config.train.grad_accum_windows)
+        accum_mode = config.train.grad_accum_mode
+
+        def _gather_windows(x_base, y_base, starts):
+            w = self.config.train.window_size
+            idx = starts[:, None] + jnp.arange(w)[None, :]    # [B, W]
+            return x_base[idx], y_base[idx]
+
+        def _accum_grads_exact(params, x_base, y_base, starts, wb, step_key):
+            folded, fold_vjp = jax.vjp(fold_feature_mask, params)
+            keys = jax.vmap(lambda g: jax.random.fold_in(step_key, g))(
+                jnp.arange(accum_g))
+
+            def micro(s, wb_g, key):
+                xb, yb = _gather_windows(x_base, y_base, s)
+
+                def loss_fn(pf):
+                    preds = self.model.apply(
+                        {"params": pf}, xb, deterministic=False,
+                        rngs={"dropout": key}, mask_folded=True)
+                    return pinball_loss(preds, yb, quantiles,
+                                        sample_weight=wb_g, allow_empty=True)
+
+                return jax.value_and_grad(loss_fn)(folded)
+
+            losses, gfolded = jax.vmap(micro)(starts, wb, keys)
+            total = None
+            for g in range(accum_g):
+                gg, = fold_vjp(jax.tree.map(lambda a, g=g: a[g], gfolded))
+                total = gg if total is None else jax.tree.map(
+                    jnp.add, total, gg)
+            return losses.astype(jnp.float32), total
+
+        def _accum_grads_flat(params, x_base, y_base, starts, wb, step_key):
+            g, b = starts.shape
+            xb, yb = _gather_windows(x_base, y_base, starts.reshape(-1))
+            x4 = xb.reshape(g, b, *xb.shape[1:])
+            y4 = yb.reshape(g, b, *yb.shape[1:])
+
+            def loss_fn(params):
+                preds = self.model.apply(
+                    {"params": params}, x4, deterministic=False,
+                    rngs={"dropout": step_key})              # [G,B,T,E,Q]
+                losses = jax.vmap(
+                    lambda p, y, w: pinball_loss(p, y, quantiles,
+                                                 sample_weight=w,
+                                                 allow_empty=True)
+                )(preds, y4, wb)
+                return jnp.sum(losses), losses
+
+            (_, losses), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return losses.astype(jnp.float32), grads
+
+        def _accum_grads_loop(params, x_base, y_base, starts, wb, step_key):
+            losses, total = [], None
+            for g in range(accum_g):
+                xb, yb = _gather_windows(x_base, y_base, starts[g])
+
+                def loss_fn(params, g=g, xb=xb, yb=yb):
+                    preds = self.model.apply(
+                        {"params": params}, xb, deterministic=False,
+                        rngs={"dropout": jax.random.fold_in(step_key, g)})
+                    return pinball_loss(preds, yb, quantiles,
+                                        sample_weight=wb[g], allow_empty=True)
+
+                lg, gg = jax.value_and_grad(loss_fn)(params)
+                losses.append(lg)
+                total = gg if total is None else jax.tree.map(jnp.add,
+                                                              total, gg)
+            return jnp.stack(losses).astype(jnp.float32), total
+
+        _accum_grads = {"exact": _accum_grads_exact,
+                        "flat": _accum_grads_flat,
+                        "loop": _accum_grads_loop}[accum_mode]
+
+        def train_accum_update(state: TrainState, x_base, y_base, starts, wb):
+            """One optimizer update from G coalesced microbatches.
+            starts/wb: [G, B]."""
+            step_key = jax.random.fold_in(state.rng, state.step)
+            losses, grads = _accum_grads(state.params, x_base, y_base,
+                                         starts, wb, step_key)
+            updates, opt_state = self.tx.update(grads, state.opt_state)
+            params = optax.apply_updates(state.params, updates)
+            n_real = jnp.sum(jnp.any(wb > 0, axis=1).astype(jnp.int32))
+            return (
+                pin_state(TrainState(step=state.step + n_real, params=params,
+                                     opt_state=opt_state, rng=state.rng)),
+                losses,
+            )
+
+        def train_accum_superstep(state: TrainState, x_base, y_base,
+                                  starts_plan, weights_plan, chunk):
+            # The G>1 twin of train_superstep: the [S, B] chunk reshapes
+            # to [S/G, G, B] (the epoch planner guarantees S % G == 0) and
+            # the scan advances one UPDATE (G microbatches) per step.
+            # Fully-padded groups take the cond skip — prior state passes
+            # through untouched, exactly like padded steps at G=1.
+            starts_c = jax.lax.dynamic_index_in_dim(
+                starts_plan, chunk, 0, keepdims=False)       # [S, B]
+            weights_c = jax.lax.dynamic_index_in_dim(
+                weights_plan, chunk, 0, keepdims=False)      # [S, B]
+            s, b = starts_c.shape
+            starts_c = starts_c.reshape(s // accum_g, accum_g, b)
+            weights_c = weights_c.reshape(s // accum_g, accum_g, b)
+
+            def body(st, update_plan):
+                starts, wb = update_plan
+
+                def run(s):
+                    return train_accum_update(s, x_base, y_base, starts, wb)
+
+                def skip(s):
+                    return s, jnp.zeros((accum_g,), jnp.float32)
+
+                return jax.lax.cond(jnp.any(wb > 0), run, skip, st)
+
+            state, losses = jax.lax.scan(body, state, (starts_c, weights_c))
+            return state, losses.reshape(-1)                 # [S] f32
+
         def eval_step(params, xb, yb):
             preds = self.model.apply({"params": params}, xb, deterministic=True)
             loss = pinball_loss(preds, yb, quantiles)
@@ -189,6 +348,7 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._train_step_indexed = jax.jit(train_step_indexed, donate_argnums=0)
         self._superstep = jax.jit(train_superstep, donate_argnums=0)
+        self._accum_superstep = jax.jit(train_accum_superstep, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._eval_step_indexed = jax.jit(eval_step_indexed)
         self._predict_step = jax.jit(
@@ -258,7 +418,15 @@ class Trainer:
             s = min(int(v), num_steps)
         cap = max(1, self._PLAN_CHUNK_MAX_BYTES
                   // (8 * self.config.train.batch_size))
-        return max(1, min(s, cap))
+        s = max(1, min(s, cap))
+        g = self.config.train.grad_accum_windows
+        if g > 1:
+            # Coalesced updates consume G microbatches at a time: round S
+            # UP to a multiple of G (the plan's zero-weight padding makes
+            # any overhang a cond-skipped group, exactly like ragged
+            # chunks at G=1).
+            s = -(-s // g) * g
+        return s
 
     def _epoch_plan(self, n: int, rng: np.random.Generator,
                     s: int) -> tuple[np.ndarray, np.ndarray, int]:
@@ -328,6 +496,14 @@ class Trainer:
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
                     epoch_rng: np.random.Generator,
                     staged=None) -> tuple[TrainState, float]:
+        accum = self.config.train.grad_accum_windows
+        if staged is None and accum > 1:
+            raise ValueError(
+                f"grad_accum_windows={accum} requires the staged "
+                "(device-resident) feed — the coalesced update consumes "
+                "its microbatches from the on-device plan; stage the "
+                "dataset (device_data='always' forces it on the CPU "
+                "backend) or set grad_accum_windows=1")
         if staged is not None:
             num_steps = -(-len(bundle.x_train) // self.config.train.batch_size)
             s = self._superstep_len(num_steps)
@@ -418,6 +594,10 @@ class Trainer:
         starts, weights, num_steps = self._epoch_plan(
             len(bundle.x_train), epoch_rng, s)
         starts_d, weights_d = stage_plan(self.mesh, starts, weights)
+        # The coalesced (grad-accum) superstep and the per-step superstep
+        # share the whole driver: only the compiled scan differs.
+        superstep = (self._accum_superstep if cfg.grad_accum_windows > 1
+                     else self._superstep)
         measuring = self._warmed
         if measuring:
             self.throughput.start()
@@ -425,8 +605,8 @@ class Trainer:
         steps = 0
         for c in range(starts.shape[0]):
             real = min(s, num_steps - c * s)
-            state, losses_c = self._superstep(state, x_base, y_base,
-                                              starts_d, weights_d, c)
+            state, losses_c = superstep(state, x_base, y_base,
+                                        starts_d, weights_d, c)
             chunk_losses.append(losses_c)
             if not self._warmed:
                 # First-ever superstep pays the scan's trace+compile.

@@ -1,0 +1,479 @@
+"""Round-11 window-coalescing tests: the bit-parity matrix for the
+coalesced recurrence (ops-level row fold, model group axis, the
+grad-accum superstep vs its unfused loop reference), the VMEM block-plan
+re-validation at fat row counts, serve-side page coalescing vs the pinned
+host reference, and the no-recompile probes.
+
+The parity bar mirrors test_superstep: EQUALITY where the design promises
+it (the "exact" accumulation mode, every forward-only path), and a
+documented, measured tolerance where float reassociation makes equality
+impossible (the "flat" mode's cross-group weight-grad contractions —
+PERF.md round 11).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeprest_tpu.config import (
+    Config, FeaturizeConfig, InferConfig, ModelConfig, TrainConfig,
+)
+from deeprest_tpu.data.featurize import featurize_buckets
+from deeprest_tpu.train import Trainer, prepare_dataset
+
+from conftest import make_series_buckets
+
+
+SMALL = Config(
+    model=ModelConfig(hidden_size=8, dropout_rate=0.1),
+    train=TrainConfig(num_epochs=2, batch_size=16, window_size=12,
+                      eval_stride=12, eval_max_cycles=4, seed=0,
+                      device_data="always"),
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    buckets = make_series_buckets(160, seed=2)
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=8))
+    return prepare_dataset(data, SMALL.train)
+
+
+def trainer_with(bundle, **train_kw):
+    cfg = Config(model=SMALL.model,
+                 train=dataclasses.replace(SMALL.train, **train_kw))
+    return Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+
+
+def run_epochs(trainer, bundle, *, epochs, seed=3):
+    staged = trainer.stage_dataset(bundle)
+    assert staged is not None
+    state = trainer.init_state(bundle.x_train, seed=seed)
+    rng = np.random.default_rng(7)
+    per_step = []
+    for _ in range(epochs):
+        state, _ = trainer.train_epoch(state, bundle, rng, staged=staged)
+        per_step.append(trainer._last_epoch_losses.copy())
+    return state, per_step
+
+
+def assert_states_bit_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(a.opt_state), jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert int(a.step) == int(b.step)
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def test_config_rejects_bad_accum():
+    with pytest.raises(ValueError, match="grad_accum_windows"):
+        TrainConfig(grad_accum_windows=0)
+    with pytest.raises(ValueError, match="grad_accum_windows"):
+        TrainConfig(grad_accum_windows=True)
+    with pytest.raises(ValueError, match="grad_accum_mode"):
+        TrainConfig(grad_accum_mode="fast")
+    TrainConfig(grad_accum_windows=4, grad_accum_mode="flat")
+    with pytest.raises(ValueError, match="coalesce_pages"):
+        InferConfig(coalesce_pages=0)
+    InferConfig(coalesce_pages=4)
+
+
+def test_superstep_len_multiple_of_g(bundle):
+    t = trainer_with(bundle, grad_accum_windows=4, steps_per_superstep=6)
+    assert t._superstep_len(100) % 4 == 0 and t._superstep_len(100) >= 4
+    # an epoch shorter than G still yields one full (padded) group
+    assert t._superstep_len(1) == 4
+
+
+def test_accum_requires_staged_feed(bundle):
+    t = trainer_with(bundle, grad_accum_windows=2)
+    state = t.init_state(bundle.x_train, seed=3)
+    with pytest.raises(ValueError, match="grad_accum_windows"):
+        t.train_epoch(state, bundle, np.random.default_rng(7), staged=None)
+
+
+# ---------------------------------------------------------------------------
+# ops-level row fold
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["scan", "pallas_interpret"])
+def test_gru_coalesced_bit_equal_per_group(backend):
+    """G folded window batches through ONE recurrence == G standalone
+    calls, bit-for-bit, on both backends (rows are independent)."""
+    from deeprest_tpu.ops.gru import (
+        bidirectional_gru, bidirectional_gru_coalesced, gru, gru_coalesced,
+        init_gru_params,
+    )
+
+    rng = np.random.default_rng(0)
+    e, f, h, g, b, t = 2, 8, 128, 3, 8, 7
+    fwd = init_gru_params(jax.random.PRNGKey(1), e, f, h)
+    bwd = init_gru_params(jax.random.PRNGKey(2), e, f, h)
+    x = jnp.asarray(rng.standard_normal((g, b, t, f)), jnp.float32)
+
+    out = gru_coalesced(fwd, x, backend=backend)
+    assert out.shape == (e, g, b, t, h)
+    outb = bidirectional_gru_coalesced(fwd, bwd, x, backend=backend)
+    for gi in range(g):
+        np.testing.assert_array_equal(
+            np.asarray(out[:, gi]), np.asarray(gru(fwd, x[gi],
+                                                   backend=backend)))
+        np.testing.assert_array_equal(
+            np.asarray(outb[:, gi]),
+            np.asarray(bidirectional_gru(fwd, bwd, x[gi], backend=backend)))
+
+
+def test_group_spec_round_trip():
+    from deeprest_tpu.ops.gru import GroupSpec, coalesce_windows, split_coalesced
+
+    x = jnp.arange(2 * 3 * 4 * 5, dtype=jnp.float32).reshape(2, 3, 4, 5)
+    flat, spec = coalesce_windows(x)
+    assert flat.shape == (6, 4, 5)
+    assert spec == GroupSpec(groups=2, rows=3) and spec.coalesced_rows == 6
+    h = jnp.zeros((7, 6, 4, 8))
+    assert split_coalesced(h, spec).shape == (7, 2, 3, 4, 8)
+    with pytest.raises(ValueError, match="rows"):
+        split_coalesced(jnp.zeros((7, 5, 4, 8)), spec)
+    with pytest.raises(ValueError, match="window groups"):
+        coalesce_windows(jnp.zeros((6, 4, 5)))
+
+
+def test_model_group_axis_and_mask_fold_bit_equal():
+    """The model's [G,B,T,F] group axis == per-group 3-D applies, and an
+    externally folded mask (fold_feature_mask + mask_folded=True) == the
+    internal fold — both bit-for-bit (the exact-mode trainer's two
+    structural prerequisites)."""
+    from deeprest_tpu.models.qrnn import QuantileGRU, fold_feature_mask
+
+    cfg = ModelConfig(feature_dim=16, num_metrics=3, hidden_size=8)
+    model = QuantileGRU(config=cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((4, 6, 12, 16), np.float32))
+    params = dict(model.init(jax.random.PRNGKey(0), x[0])["params"])
+
+    p4 = model.apply({"params": params}, x)
+    assert p4.shape == (4, 6, 12, 3, 3)
+    for g in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(p4[g]), np.asarray(model.apply({"params": params},
+                                                      x[g])))
+
+    jit_folded = jax.jit(lambda p, xb: model.apply(
+        {"params": fold_feature_mask(p)}, xb, mask_folded=True))
+    jit_normal = jax.jit(lambda p, xb: model.apply({"params": p}, xb))
+    np.testing.assert_array_equal(np.asarray(jit_folded(params, x[0])),
+                                  np.asarray(jit_normal(params, x[0])))
+
+
+# ---------------------------------------------------------------------------
+# VMEM block-plan re-validation at fat rows
+# ---------------------------------------------------------------------------
+
+
+def test_block_plan_fat_rows_flagship():
+    """The footprint model at the coalesced row counts (flagship E=40,
+    T=60, H=128): production bf16 TRAINING fits through G=4 (time blocks
+    shrink to absorb the fatter rows), G=8 training exceeds scoped VMEM
+    even at the minimum legal block (the documented coalescing cap), and
+    bf16 INFERENCE fits through G=8 (the serve-side fold)."""
+    from deeprest_tpu.ops import pallas_gru
+
+    for g, expect_fit in ((1, True), (2, True), (4, True), (8, False)):
+        plan = pallas_gru.block_plan(40, 60, 32 * g, 128,
+                                     dtype=jnp.bfloat16, training=True)
+        assert plan["fits"] is expect_fit, (g, plan)
+        assert plan["e_blk"] % 8 == 0 or plan["e_blk"] == 40
+        assert plan["t_blk"] >= 1
+        assert plan["b_padded"] >= 32 * g
+    infer8 = pallas_gru.block_plan(40, 60, 256, 128,
+                                   dtype=jnp.bfloat16, training=False)
+    assert infer8["fits"], infer8
+    # the plan predicts the same blocking the kernel call would choose:
+    # its byte model is the kernels' own (shared helpers), so a fitting
+    # plan means the compile-time chooser cannot OOM scoped VMEM
+    assert plan["budget"] == pallas_gru._VMEM_BUDGET
+
+
+def test_block_plan_matches_kernel_execution():
+    """A coalesced fat-row batch runs through the REAL (interpret-mode)
+    kernel at a shape whose block plan fits — fwd and VJP."""
+    from deeprest_tpu.ops import pallas_gru
+    from deeprest_tpu.ops.gru import gru_coalesced, init_gru_params
+
+    e, f, h, g, b, t = 2, 8, 128, 4, 8, 7
+    plan = pallas_gru.block_plan(e, t, g * b, h, training=True)
+    assert plan["fits"]
+    params = init_gru_params(jax.random.PRNGKey(0), e, f, h)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((g, b, t, f)),
+                    jnp.float32)
+
+    def loss(p):
+        return jnp.sum(gru_coalesced(p, x, backend="pallas_interpret") ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(grads))
+
+
+# ---------------------------------------------------------------------------
+# grad-accum superstep parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("g", [2, 4])
+def test_accum_exact_bit_identical_to_loop(bundle, g):
+    """The fused 'exact' coalesced update == the unfused accumulation
+    loop, bit-for-bit: per-microbatch losses, params, optimizer state,
+    and step counter, across epochs with ragged chunks — WITH dropout on
+    (the per-microbatch fold_in streams reproduce under vmap)."""
+    t_loop = trainer_with(bundle, grad_accum_windows=g,
+                          grad_accum_mode="loop", steps_per_superstep=4)
+    s_loop, l_loop = run_epochs(t_loop, bundle, epochs=2)
+    t_exact = trainer_with(bundle, grad_accum_windows=g,
+                           grad_accum_mode="exact", steps_per_superstep=4)
+    s_exact, l_exact = run_epochs(t_exact, bundle, epochs=2)
+    for a, b in zip(l_exact, l_loop):
+        np.testing.assert_array_equal(a, b)
+    assert_states_bit_equal(s_exact, s_loop)
+    # K=4 microbatches/epoch: the counter still counts REAL microbatches
+    assert int(s_exact.step) == 2 * 4
+
+
+def test_accum_flat_losses_exact_params_tolerance(bundle):
+    """'flat' mode (kernel-level row fold): per-microbatch losses of the
+    FIRST update are bit-exact vs the loop (forward is row-independent),
+    and params stay within the documented ~1e-7-relative reassociation
+    envelope — the cross-group fma-chains in the weight-grad contractions
+    cannot reproduce the loop's per-group-sum association (PERF.md round
+    11).  Dropout 0: flat draws one fat mask, a different (equally valid)
+    stream than the loop's per-microbatch draws."""
+    model = dataclasses.replace(SMALL.model, dropout_rate=0.0)
+
+    def tr(mode):
+        cfg = Config(model=model,
+                     train=dataclasses.replace(
+                         SMALL.train, grad_accum_windows=2,
+                         grad_accum_mode=mode, steps_per_superstep=4))
+        return Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+
+    s_loop, l_loop = run_epochs(tr("loop"), bundle, epochs=1)
+    s_flat, l_flat = run_epochs(tr("flat"), bundle, epochs=1)
+    np.testing.assert_array_equal(l_flat[0][:2], l_loop[0][:2])
+    for x, y in zip(jax.tree.leaves(s_flat.params),
+                    jax.tree.leaves(s_loop.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=5e-6, atol=1e-7)
+    assert int(s_flat.step) == int(s_loop.step)
+
+
+def test_accum_g1_config_uses_historical_superstep(bundle):
+    """grad_accum_windows=1 (the default) must route through the EXISTING
+    superstep — the G>1 machinery is never silently entered — and match
+    the per-step loop bit-for-bit exactly as before."""
+    t1 = trainer_with(bundle, grad_accum_windows=1, steps_per_superstep=3)
+    t_step = trainer_with(bundle, steps_per_superstep=1)
+    s1, _ = run_epochs(t1, bundle, epochs=2)
+    s_step, _ = run_epochs(t_step, bundle, epochs=2)
+    assert_states_bit_equal(s1, s_step)
+
+
+def test_accum_one_executable_across_epochs(bundle):
+    """The no-recompile probe at G>1: epochs of chunks — full and ragged,
+    fresh epoch plans — reuse ONE accum-superstep executable."""
+    t = trainer_with(bundle, grad_accum_windows=2, steps_per_superstep=4)
+    staged = t.stage_dataset(bundle)
+    state = t.init_state(bundle.x_train, seed=3)
+    rng = np.random.default_rng(7)
+    state, _ = t.train_epoch(state, bundle, rng, staged=staged)
+    probe = getattr(t._accum_superstep, "_cache_size", None)
+    if not callable(probe):
+        pytest.skip("jax version exposes no jit cache probe")
+    assert probe() == 1
+    for _ in range(2):
+        state, _ = t.train_epoch(state, bundle, rng, staged=staged)
+    assert probe() == 1
+    # G is a plan-shape static: a DIFFERENT G is its own trainer/executable
+    # (test_accum_exact_bit_identical_to_loop exercises G=2 and G=4; each
+    # holds the invariant independently).
+
+
+def test_accum_smoke_fit(bundle):
+    """End-to-end: a 2-epoch Trainer.fit with coalesced updates on,
+    exercising plan staging, the accum scan, ragged padding, eval."""
+    cfg = Config(model=SMALL.model,
+                 train=dataclasses.replace(SMALL.train, grad_accum_windows=2,
+                                           steps_per_superstep="auto",
+                                           num_epochs=2))
+    t = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    state, history = t.fit(bundle)
+    assert len(history) == 2
+    assert all(np.isfinite(h.train_loss) for h in history)
+    assert all(np.isfinite(h.test_loss) for h in history)
+    assert int(state.step) == 2 * 4
+    assert t._last_epoch_losses.shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# bidirectional: revert default + fused path stays covered behind the knob
+# ---------------------------------------------------------------------------
+
+
+def test_bidir_default_unfused_and_fused_knob_parity(monkeypatch):
+    """Round 11 reverts fused bidirectional (PERF.md: on-chip unfused
+    122.0 beat fused 117.2): the DEFAULT pallas path is two calls.  The
+    fused kernel stays behind BIDIR_FUSED for on-chip A/B and must keep
+    matching the scan spec."""
+    import importlib
+
+    # deeprest_tpu.ops re-exports the gru FUNCTION, shadowing the module
+    # on attribute access — importlib reaches the module unambiguously.
+    gru_mod = importlib.import_module("deeprest_tpu.ops.gru")
+
+    assert gru_mod.BIDIR_FUSED is False   # the revert, default off
+
+    rng = np.random.default_rng(3)
+    fwd = gru_mod.init_gru_params(jax.random.PRNGKey(1), 3, 8, 128)
+    bwd = gru_mod.init_gru_params(jax.random.PRNGKey(2), 3, 8, 128)
+    x = jnp.asarray(rng.standard_normal((4, 9, 8)), jnp.float32)
+    ref = np.asarray(gru_mod.bidirectional_gru(fwd, bwd, x, backend="scan"))
+
+    unfused = np.asarray(gru_mod.bidirectional_gru(
+        fwd, bwd, x, backend="pallas_interpret"))
+    monkeypatch.setattr(gru_mod, "BIDIR_FUSED", True)
+    fused = np.asarray(gru_mod.bidirectional_gru(
+        fwd, bwd, x, backend="pallas_interpret"))
+    np.testing.assert_allclose(unfused, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-5)
+    # direction fusion is pure plumbing: both kernel routes agree exactly
+    np.testing.assert_array_equal(unfused, fused)
+
+
+# ---------------------------------------------------------------------------
+# serve-side page coalescing
+# ---------------------------------------------------------------------------
+
+
+def _tiny_serving():
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.models.qrnn import QuantileGRU
+
+    rng = np.random.default_rng(0)
+    e, f, w = 4, 8, 6
+    cfg = ModelConfig(feature_dim=f, num_metrics=e, hidden_size=8)
+    model = QuantileGRU(config=cfg)
+    params = dict(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, w, f), jnp.float32))["params"])
+    apply_fn = lambda p, x: model.apply({"params": p}, x, deterministic=True)
+    xs = rng.random((100, f)).astype(np.float32) * 5
+    x_stats = MinMaxStats(min=xs.min(0), max=xs.max(0))
+    y_stats = MinMaxStats(min=np.zeros(e, np.float32),
+                          max=np.ones(e, np.float32))
+    dm = np.zeros(e, bool)
+    dm[1] = True
+    series = [rng.random((t, f)).astype(np.float32) * 5
+              for t in (37, 18, 64, 6, 29)]
+    return apply_fn, params, x_stats, y_stats, w, dm, series
+
+
+def test_fused_engine_page_coalescing_parity_and_dispatch_reduction():
+    """coalesce_pages folds consecutive pages into one dispatch: same
+    numerics contract as the uncoalesced engine (non-delta BIT-EXACT vs
+    the pinned host reference, delta within the documented tolerance),
+    fewer dispatches, fatter rows, and only super-rung executables
+    added."""
+    from deeprest_tpu.serve.fused import FusedRolledEngine
+    from deeprest_tpu.serve.predictor import rolled_prediction_reference
+
+    apply_fn, params, x_stats, y_stats, w, dm, series = _tiny_serving()
+    japply = jax.jit(apply_fn)
+    ref_apply = lambda x: np.asarray(japply(params, jnp.asarray(x)))
+
+    def engine(coalesce):
+        return FusedRolledEngine(apply_fn, x_stats, y_stats, w,
+                                 params=params, delta_mask=dm,
+                                 median_index=1, page_windows=8,
+                                 coalesce_pages=coalesce)
+
+    eng1, eng4 = engine(1), engine(4)
+    assert eng4.rungs == (8, 16, 24, 32, 64)      # super-rungs 16/24/32
+    out1 = eng1.predict_many(series)
+    out4 = eng4.predict_many(series)
+    nd = ~dm
+    for s, a, b in zip(series, out1, out4):
+        ref = rolled_prediction_reference(ref_apply, x_stats, y_stats, w,
+                                          s, delta_mask=dm, median_index=1)
+        np.testing.assert_array_equal(a[:, nd], ref[:, nd])
+        np.testing.assert_array_equal(b[:, nd], ref[:, nd])
+        np.testing.assert_allclose(b[:, dm], ref[:, dm], rtol=2e-5,
+                                   atol=1e-5)
+    s1, s4 = eng1.stats(), eng4.stats()
+    assert s4["pages"] < s1["pages"]               # dispatch reduction
+    assert s4["max_dispatch_rows"] > s1["max_dispatch_rows"]
+    assert s4["coalesce_pages"] == 4
+    # repeat traffic adds ZERO new executables (rungs already compiled)
+    before = eng4.cache_size()
+    eng4.predict_many(series)
+    if before is not None:
+        assert eng4.cache_size() == before
+
+
+def test_fused_engine_coalesce_validation():
+    from deeprest_tpu.serve.fused import FusedRolledEngine
+
+    apply_fn, params, x_stats, y_stats, w, dm, _ = _tiny_serving()
+    with pytest.raises(ValueError, match="coalesce_pages"):
+        FusedRolledEngine(apply_fn, x_stats, y_stats, w, params=params,
+                          delta_mask=dm, median_index=1,
+                          coalesce_pages=0)
+
+
+def test_shape_ladder_super_rungs():
+    from deeprest_tpu.serve.batcher import ShapeLadder
+
+    lad = ShapeLadder(lambda x: x, (8, 16, 32, 64), coalesce_groups=4)
+    assert lad.base_ladder == (8, 16, 32, 64)
+    assert lad.ladder == (8, 16, 32, 64, 128, 192, 256)
+    assert lad.max_rung == 256
+    assert lad.rung_for(100) == 128
+    assert lad.stats()["coalesce_groups"] == 4
+    # default: unchanged behavior
+    plain = ShapeLadder(lambda x: x, (8, 16, 32, 64))
+    assert plain.ladder == plain.base_ladder == (8, 16, 32, 64)
+    with pytest.raises(ValueError, match="coalesce_groups"):
+        ShapeLadder(lambda x: x, (8,), coalesce_groups=0)
+
+
+def test_predictor_coalesce_plumbing(tmp_path):
+    """coalesce_pages / coalesce_groups survive the checkpoint round-trip
+    into a Predictor (CLI serve/predict path)."""
+    from deeprest_tpu.serve.predictor import Predictor
+
+    buckets = make_series_buckets(120, seed=5)
+    data = featurize_buckets(buckets, FeaturizeConfig(round_to=8))
+    cfg = Config(model=ModelConfig(hidden_size=8),
+                 train=dataclasses.replace(SMALL.train, num_epochs=1))
+    bundle = prepare_dataset(data, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    state, _ = tr.fit(bundle, num_epochs=1)
+    ck = str(tmp_path / "ck")
+    tr.save(ck, state, bundle)
+
+    pred = Predictor.from_checkpoint(ck, coalesce_pages=2,
+                                     coalesce_groups=2)
+    assert pred.fused is not None
+    assert pred.fused.coalesce_pages == 2
+    assert pred.ladder.ladder[-1] == 2 * pred.ladder.base_ladder[-1]
+    t = np.random.default_rng(0).random(
+        (3 * bundle.window_size + 5, bundle.feature_dim)).astype(np.float32)
+    out = pred.predict_series(t)
+    assert out.shape == (len(t), len(bundle.metric_names), 3)
+    assert np.isfinite(out).all()
