@@ -1,11 +1,16 @@
 # Repo-level convenience targets.
 #
-#   make lint    graftlint over the package, JSON output (the same gate
-#                tests/test_lint_clean.py enforces in tier-1; see
-#                ANALYSIS.md for the rule catalog)
-#   make native  build the C++ featurizer (native/Makefile)
-#   make tsan    build the thread-sanitized featurizer selftest — the
-#                native-side twin of the TH rule pack
+#   make lint             graftlint over the package, JSON output (the
+#                         same gate tests/test_lint_clean.py enforces in
+#                         tier-1; see ANALYSIS.md for the rule catalog)
+#   make native           build the C++ featurizer (native/Makefile)
+#   make tsan             build the thread-sanitized featurizer selftest
+#                         — the native-side twin of the TH rule pack
+#   make bench-multichip  the mesh-shape scaling sweep on the 8-device
+#                         virtual CPU mesh, quick tier (locally
+#                         reproducible in a few minutes; refreshes
+#                         MULTICHIP_r06.json — the real curve rides
+#                         benchmarks/tpu_queue.sh)
 
 PYTHON ?= python
 
@@ -18,4 +23,7 @@ native:
 tsan:
 	$(MAKE) -C native tsan
 
-.PHONY: lint native tsan
+bench-multichip:
+	$(PYTHON) bench.py --mesh --quick --out MULTICHIP_r06.json
+
+.PHONY: lint native tsan bench-multichip

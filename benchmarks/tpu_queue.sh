@@ -73,6 +73,14 @@ step kernel_tuning 2700 python benchmarks/kernel_tuning.py --coalesce \
   --out benchmarks/kernel_tuning_r11.json
 step superstep_sweep 1800 python benchmarks/superstep_sweep.py --flagship \
   --out benchmarks/superstep_sweep_tpu.json
+# Mesh-shape scaling sweep (round 12): the data×expert×model curve the
+# virtual CPU mesh can only prove plumbing for — flagship shapes at bf16
+# across {1x1x1, 8x1x1, 2x2x2, 4x2x1, 2x1x4} (capped to the attached
+# device count), honest-sync per trial, aggregate flagship MFU banked in
+# the dossier.  Single attached chip: the 1x1x1 row still exercises the
+# sharded feed + rule-table path on hardware.
+step multichip_sweep 2700 python benchmarks/multichip_sweep.py \
+  --out benchmarks/multichip_tpu_r06.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -18,6 +18,12 @@ Each rule encodes a bug class this repo has already paid for by hand:
 - JX004 — ``donate_argnums`` invalidates the donated buffer; reading the
   Python reference afterwards returns garbage or raises at dispatch
   (train/trainer.py donates the train state at every step).
+- JX005 — PR 7 deleted the trainer's hand-pinned per-leaf spec dict in
+  favor of the ONE regex partition-rule table
+  (parallel/sharding.PARTITION_RULES); a ``NamedSharding(mesh, P(...))``
+  literal anywhere else re-creates the two-owners drift that table
+  exists to kill (train pinned F on ``model`` while serve replicated it
+  — the ROADMAP item 4 hazard).
 """
 
 from __future__ import annotations
@@ -302,6 +308,47 @@ class JX003ReadbackInHotLoop(Rule):
                     "numpy.array"):
             return f"{name}()"
         return None
+
+
+@register
+class JX005HandPinnedShardingSpec(Rule):
+    id = "JX005"
+    title = ("NamedSharding constructed outside parallel/sharding.py "
+             "(hand-pinned partition spec bypassing the rule table)")
+    guards = ("PR 7: pin_state's per-leaf spec dict and serve's implicit "
+              "replication were two divergent owners of the same "
+              "placement decisions; every sharding now resolves from "
+              "parallel/sharding.PARTITION_RULES, and an ad-hoc "
+              "NamedSharding literal elsewhere silently re-forks that "
+              "ownership (suppress with a reason only for the designed "
+              "batch/plan FEED sites, which place inputs, not state)")
+
+    # The single module allowed to construct NamedSharding: the owner of
+    # the partition-rule table.  Matched on path components so both
+    # package-dir and repo-root lint invocations resolve it.
+    ALLOWED_SUFFIX = ("parallel", "sharding.py")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            parts = tuple(sf.rel.replace("\\", "/").split("/"))
+            if parts[-2:] == self.ALLOWED_SUFFIX:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if call_name(node.func) not in (
+                        "NamedSharding", "jax.sharding.NamedSharding",
+                        "sharding.NamedSharding"):
+                    continue
+                yield sf.finding(
+                    node, self.id,
+                    "NamedSharding literal outside parallel/sharding.py: "
+                    "state placement must resolve from the partition-rule "
+                    "table (state_sharding/param_sharding/batch_sharding); "
+                    "a second spec owner is how train and serve shardings "
+                    "drift apart")
 
 
 @register

@@ -90,6 +90,31 @@ def _add_fused_infer_args(p: argparse.ArgumentParser):
                         "cache-bound faster there — 4 on accelerators)")
 
 
+def _add_mesh_arg(p: argparse.ArgumentParser, serving: bool = False):
+    extra = (" (serving: shardings resolve from the same partition-rule "
+             "table training pins with — parallel/sharding.py — so "
+             "model=N gives the ladder + fused engine feature-axis TP)"
+             if serving else
+             " (multi-host joins via JAX_COORDINATOR_ADDRESS / pod "
+             "metadata first)")
+    p.add_argument("--mesh", default=None, metavar="D,E,M",
+                   help="device mesh data,expert,model (default 1,1,1)"
+                        + extra)
+
+
+def _parse_mesh(args):
+    """``args.mesh`` → MeshConfig | None (exits with a message on a bad
+    spec — the shared contract of every --mesh flag)."""
+    from deeprest_tpu.config import MeshConfig
+
+    if not getattr(args, "mesh", None):
+        return None
+    try:
+        return MeshConfig.parse(args.mesh)
+    except ValueError as exc:
+        sys.exit(f"error: {exc}")
+
+
 def _superstep_arg(v: str):
     """``--steps-per-superstep`` parser: int >= 1, 'auto', or 'epoch'."""
     if v in ("auto", "epoch"):
@@ -253,15 +278,7 @@ def cmd_train(args) -> int:
               f"{jax.process_count()}, {len(jax.devices())} global devices",
               flush=True)
 
-    mesh_cfg = MeshConfig()
-    if args.mesh:
-        try:
-            d, e, m = (int(x) for x in args.mesh.split(","))
-        except ValueError:
-            sys.exit(f"error: --mesh {args.mesh!r} is not data,expert,model")
-        if min(d, e, m) < 1:
-            sys.exit(f"error: --mesh {args.mesh!r}: axis sizes must be >= 1")
-        mesh_cfg = MeshConfig(data=d, expert=e, model=m)
+    mesh_cfg = _parse_mesh(args) or MeshConfig()
 
     _require_input(args)
     data = _load_features(args)
@@ -480,7 +497,8 @@ def cmd_whatif(args) -> int:
     pred = Predictor.from_checkpoint(
         args.ckpt_dir, fused=not args.no_fused_infer,
         page_windows=args.infer_page_windows,
-        coalesce_pages=args.infer_coalesce_pages)
+        coalesce_pages=args.infer_coalesce_pages,
+        mesh_config=_parse_mesh(args))
     space = pred.space()
     if space is None:
         sys.exit("error: checkpoint has no feature space; cannot fit the "
@@ -574,6 +592,11 @@ def cmd_serve(args) -> int:
                  "immutable; re-export and restart instead)")
     if args.watch < 0:
         sys.exit(f"error: --watch {args.watch} must be >= 0")
+    mesh_cfg = _parse_mesh(args)
+    if mesh_cfg is not None and args.artifact:
+        sys.exit("error: --mesh requires --ckpt-dir (exported artifacts "
+                 "bake single-device params; re-serve from the checkpoint "
+                 "to shard them)")
     reloader = None
     if args.ckpt_dir:
         from deeprest_tpu.serve.predictor import Predictor
@@ -588,12 +611,14 @@ def cmd_serve(args) -> int:
                 fused=not args.no_fused_infer,
                 page_windows=args.infer_page_windows,
                 coalesce_pages=args.infer_coalesce_pages,
-                coalesce_groups=args.batch_coalesce_groups)
+                coalesce_groups=args.batch_coalesce_groups,
+                mesh_config=mesh_cfg)
         pred = Predictor.from_checkpoint(
             args.ckpt_dir, ladder=ladder, fused=not args.no_fused_infer,
             page_windows=args.infer_page_windows,
             coalesce_pages=args.infer_coalesce_pages,
-            coalesce_groups=args.batch_coalesce_groups)
+            coalesce_groups=args.batch_coalesce_groups,
+            mesh_config=mesh_cfg)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
             backend += " (watching)"
@@ -648,7 +673,8 @@ def _predictor(args):
         args.ckpt_dir,
         fused=not getattr(args, "no_fused_infer", False),
         page_windows=getattr(args, "infer_page_windows", None),
-        coalesce_pages=getattr(args, "infer_coalesce_pages", None))
+        coalesce_pages=getattr(args, "infer_coalesce_pages", None),
+        mesh_config=_parse_mesh(args))
 
 
 def _serving_traffic(args, pred) -> np.ndarray:
@@ -903,10 +929,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; 'flat' folds rows straight through the "
                         "kernel (max MXU row occupancy, ~1e-7 grad "
                         "reassociation); 'loop' is the unfused reference")
-    p.add_argument("--mesh", default=None, metavar="D,E,M",
-                   help="device mesh data,expert,model (default 1,1,1; "
-                        "multi-host joins via JAX_COORDINATOR_ADDRESS / "
-                        "pod metadata first)")
+    _add_mesh_arg(p)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--plots-dir", default=None)
     p.add_argument("--profile-dir", default=None,
@@ -1026,6 +1049,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default=None,
                    help="also write the full result JSON here")
     _add_fused_infer_args(p)
+    _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_whatif)
 
     p = sub.add_parser("export",
@@ -1074,6 +1098,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "of G sequential top-rung dispatches; raise "
                         "--batch-max-windows to match")
     _add_fused_infer_args(p)
+    _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("lint",
@@ -1105,6 +1130,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--out", default="predictions.npz")
     _add_fused_infer_args(p)
+    _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_predict)
 
     p = sub.add_parser("anomaly", help="traffic-justified utilization check")

@@ -270,6 +270,19 @@ class MeshConfig:
     def size(self) -> int:
         return self.data * self.expert * self.model
 
+    @classmethod
+    def parse(cls, spec: str) -> "MeshConfig":
+        """``"D,E,M"`` → MeshConfig (the shared ``--mesh`` CLI contract
+        for train, serve, predict, and bench)."""
+        try:
+            d, e, m = (int(x) for x in spec.split(","))
+        except ValueError:
+            raise ValueError(
+                f"mesh spec {spec!r} is not data,expert,model") from None
+        if min(d, e, m) < 1:
+            raise ValueError(f"mesh spec {spec!r}: axis sizes must be >= 1")
+        return cls(data=d, expert=e, model=m)
+
 
 @dataclasses.dataclass(frozen=True)
 class Config:

@@ -2,11 +2,15 @@
 
 from deeprest_tpu.parallel.mesh import make_mesh
 from deeprest_tpu.parallel.sharding import (
+    PARTITION_RULES,
     batch_sharding,
+    match_partition_rules,
     param_sharding,
     param_specs,
     shard_batch,
     shard_params,
+    state_sharding,
+    state_specs,
 )
 from deeprest_tpu.parallel.distributed import (
     feed_global_batch,
@@ -19,6 +23,10 @@ from deeprest_tpu.parallel.distributed import (
 
 __all__ = [
     "make_mesh",
+    "PARTITION_RULES",
+    "match_partition_rules",
+    "state_sharding",
+    "state_specs",
     "batch_sharding",
     "param_sharding",
     "param_specs",

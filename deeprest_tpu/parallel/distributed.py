@@ -96,29 +96,61 @@ def process_batch_slice(global_batch: int,
     return slice(process_index * per, (process_index + 1) * per)
 
 
+def _feed_data_sharded(mesh: Mesh, arr: np.ndarray,
+                       axes: tuple[str | None, ...]) -> jax.Array:
+    """The ONE per-host feed path: slice this process's contiguous chunk
+    of the ``data``-sharded axis and let
+    ``jax.make_array_from_process_local_data`` stitch the global array.
+
+    Under one process the local slice IS the global array, so the virtual
+    CPU mesh exercises the exact multi-process assembly code (not a
+    device_put twin of it) — no host ever ships another host's rows to
+    its devices, and there is no second code path to drift.
+    """
+    ax = axes.index("data")
+    n = int(arr.shape[ax])
+    data_size = int(mesh.shape["data"])
+    if n % data_size != 0:
+        # device_put would raise an opaque GSPMD shape error here — and
+        # older jax versions silently REPLICATED the batch instead of
+        # sharding it (8x the per-device memory and a wrong-throughput
+        # measurement, never a wrong result).  Fail loudly with the fix:
+        # the trainer's _batches/_epoch_plan already pad ragged batches
+        # with zero-weight rows, so a divisible batch size is one config
+        # knob away.
+        raise ValueError(
+            f"batch axis {ax} of shape {tuple(arr.shape)} has {n} rows, "
+            f"not divisible by the mesh data axis ({data_size} shards); "
+            "pad the batch to a multiple with zero-weight rows (the "
+            "trainer's _batches wrap-padding) or pick a batch size "
+            "divisible by MeshConfig.data")
+    # graftlint: disable=JX005 -- designed feed-path site: batch/plan arrays are constructed here from the table-owned axis names, not per-leaf state specs
+    sharding = NamedSharding(mesh, P(*axes))
+    sel = (slice(None),) * ax + (process_batch_slice(n),)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(arr[sel]))
+
+
 def feed_global_batch(mesh: Mesh, global_batch: np.ndarray,
                       axes: tuple[str | None, ...] | None = None) -> jax.Array:
     """Turn the host-side GLOBAL batch into the global data-sharded array.
 
     Every process passes the same ``global_batch`` view (deterministic
     selection keeps them identical across hosts); each keeps only its
-    :func:`process_batch_slice` and ``make_array_from_process_local_data``
-    stitches the global array — no host ever ships another host's rows to
-    its devices.  Under one process this is just a sharded device_put, so
-    the trainer uses one feed path everywhere.
+    :func:`process_batch_slice` of the ``data`` axis and the
+    per-host assembly (:func:`_feed_data_sharded`) stitches the global
+    array.  A batch axis not divisible by the mesh's data-axis size
+    raises immediately (it used to silently replicate on older jax).
     """
     if axes is None:
         axes = ("data",) + (None,) * (global_batch.ndim - 1)
-    sharding = NamedSharding(mesh, P(*axes))
-    if jax.process_count() == 1:
-        return jax.device_put(global_batch, sharding)
-    local = global_batch[process_batch_slice(len(global_batch))]
-    return jax.make_array_from_process_local_data(sharding, np.asarray(local))
+    return _feed_data_sharded(mesh, np.asarray(global_batch), axes)
 
 
 def feed_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     """A fully-replicated global array from identical per-process data
     (eval/predict inputs: every process holds the same windows)."""
+    # graftlint: disable=JX005 -- designed feed-path site: replicated input placement, not a per-leaf state spec
     sharding = NamedSharding(mesh, P())
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
@@ -163,12 +195,7 @@ def stage_plan(mesh: Mesh, starts: np.ndarray,
     """
     def ship(a: np.ndarray) -> jax.Array:
         axes = (None,) * (a.ndim - 1) + ("data",)
-        sharding = NamedSharding(mesh, P(*axes))
-        if jax.process_count() == 1:
-            return jax.device_put(a, sharding)
-        local = a[..., process_batch_slice(a.shape[-1])]
-        return jax.make_array_from_process_local_data(
-            sharding, np.ascontiguousarray(local))
+        return _feed_data_sharded(mesh, np.asarray(a), axes)
 
     return ship(np.asarray(starts)), ship(np.asarray(weights))
 

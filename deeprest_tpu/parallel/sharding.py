@@ -1,67 +1,141 @@
-"""Sharding rules: parameter-name → PartitionSpec.
+"""Sharding rules: ONE ordered regex table, every TrainState leaf path.
 
 Every QuantileGRU parameter carries a leading expert axis (models/qrnn.py),
 so EP is uniformly "axis 0 on ``expert``"; TP shards the call-path feature
-dimension F where it appears (the mask output and the GRU input
+dimension F where it appears (the mask output and the layer-0 GRU input
 projections — the two places that grow with the endpoint vocabulary,
 SURVEY.md §7.3); everything else is replicated.  The batch shards on
 ``data``.  No manual collectives anywhere: the cross-expert mixing sum and
 the gradient all-reduce are inserted by GSPMD from these annotations.
+
+The table below (:data:`PARTITION_RULES`) is the SINGLE owner of those
+decisions: an ordered ``(regex, PartitionSpec)`` list matched against
+"/"-joined pytree leaf paths (the SNIPPETS.md [2]/[3]
+``match_partition_rules`` shape).  Trainer ``pin_state``, checkpoint
+restore, and the serving plane all resolve shardings here — there are no
+hand-pinned per-leaf spec dicts anywhere else (graftlint JX005 enforces
+that NamedSharding literals stay out of other modules).  Optimizer state
+needs no rules of its own: Adam's ``mu``/``nu`` mirror the params dict
+keyed by the same names, so the param rules match their paths too.
+
+Strict mode errors on any leaf no rule matches: a new TrainState leaf must
+be *placed deliberately*, not silently replicated (the silent-collapse
+class behind the PR 2 double-executable incident).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Mapping
+from typing import Any, Mapping, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# parameter name → spec; F is the TP-sharded feature axis.
-_PARAM_SPECS: dict[str, P] = {
-    "mask_w1": P("expert", None),            # [E, H]
-    "mask_b1": P("expert", None),            # [E, H]
-    "mask_w2": P("expert", None, "model"),   # [E, H, F]
-    "mask_b2": P("expert", "model"),         # [E, F]
-    "gru_fwd_w_ih": P("expert", "model", None),  # [E, F, 3H]
-    "gru_bwd_w_ih": P("expert", "model", None),
-    "gru_fwd_w_hh": P("expert", None, None),     # [E, H, 3H]
-    "gru_bwd_w_hh": P("expert", None, None),
-    "gru_fwd_b_ih": P("expert", None),       # [E, 3H]
-    "gru_bwd_b_ih": P("expert", None),
-    "gru_fwd_b_hh": P("expert", None),
-    "gru_bwd_b_hh": P("expert", None),
-    "head_w": P("expert", None, None),       # [E, 4H, Q]
-    "head_b": P("expert", None),             # [E, Q]
-}
+# Ordered: first match wins.  Patterns run (re.search) against "/"-joined
+# leaf paths such as ``params/mask_w2`` or ``opt_state/0/mu/gru_fwd_w_ih``,
+# so ``(^|/)name$`` anchors on the leaf name wherever it sits in the tree.
+PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    # -- soft feature mask MLP ------------------------------------------
+    (r"(^|/)mask_w1$", P("expert", None)),             # [E, H]
+    (r"(^|/)mask_b1$", P("expert", None)),             # [E, H]
+    (r"(^|/)mask_w2$", P("expert", None, "model")),    # [E, H, F]  TP out
+    (r"(^|/)mask_b2$", P("expert", "model")),          # [E, F]     TP out
+    # -- GRU stacks: deep-layer (_lN) w_ih consumes the 2H hidden output
+    # of the previous layer, not the TP-sharded feature axis — those
+    # replicate like w_hh.  Order matters: the _lN rule must win before
+    # the layer-0 w_ih rule below.
+    (r"(^|/)gru_(fwd|bwd)_l\d+_w_ih$", P("expert", None, None)),
+    (r"(^|/)gru_(fwd|bwd)_w_ih$", P("expert", "model", None)),  # [E, F, 3H]
+    (r"(^|/)gru_(fwd|bwd)(_l\d+)?_w_hh$", P("expert", None, None)),
+    (r"(^|/)gru_(fwd|bwd)(_l\d+)?_b_(ih|hh)$", P("expert", None)),
+    # -- quantile heads --------------------------------------------------
+    (r"(^|/)head_w$", P("expert", None, None)),        # [E, 4H, Q]
+    (r"(^|/)head_b$", P("expert", None)),              # [E, Q]
+    # -- TrainState bookkeeping: replicated everywhere -------------------
+    #    step (scalar), the PRNG key, Adam's update counter.
+    (r"(^|/)(step|rng|count)$", P()),
+)
 
 
-_LAYER_SUFFIX = re.compile(r"_l\d+(_)")
+def leaf_path_name(path: Sequence[Any]) -> str:
+    """``tree_flatten_with_path`` key path → the "/"-joined rule name
+    (``params/mask_w2``, ``opt_state/0/mu/head_w``, ``rng``)."""
+    parts = []
+    for entry in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
 
 
-def _rule_key(name: str) -> str:
-    """Canonical rule name: stacked-layer params (gru_fwd_l1_w_ih) share the
-    base rule, except deep-layer w_ih whose input dim is hidden-sized (2H),
-    not the TP-sharded feature axis — those replicate like w_hh."""
-    base = _LAYER_SUFFIX.sub(r"\1", name)
-    if base != name and base.endswith("_w_ih"):
-        return base.replace("_w_ih", "_w_hh")
-    return base
+def _leaf_ndim(leaf: Any) -> int:
+    return getattr(leaf, "ndim", np.ndim(leaf))
+
+
+def _leaf_size(leaf: Any) -> int:
+    return int(getattr(leaf, "size", np.size(leaf)))
+
+
+def match_partition_rules(tree: Any,
+                          rules: Sequence[tuple[str, P]] = PARTITION_RULES,
+                          strict: bool = True) -> Any:
+    """A PartitionSpec pytree mirroring ``tree``, resolved from ``rules``.
+
+    Scalar (and single-element) leaves replicate without consulting the
+    table — there is nothing to shard.  Otherwise the FIRST rule whose
+    regex ``search``-matches the leaf's "/"-joined path wins.  ``strict``
+    raises ``KeyError`` on an unmatched leaf instead of silently
+    replicating it: every new TrainState leaf must be placed on the mesh
+    deliberately.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def resolve(path, leaf):
+        if _leaf_ndim(leaf) == 0 or _leaf_size(leaf) <= 1:
+            return P()
+        name = leaf_path_name(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                return spec
+        if strict:
+            raise KeyError(
+                f"no partition rule matches leaf {name!r} "
+                f"(shape {tuple(np.shape(leaf))}); add a rule to "
+                "parallel/sharding.PARTITION_RULES — strict mode refuses "
+                "to replicate unknown state silently")
+        return P()
+
+    return jax.tree_util.tree_map_with_path(resolve, tree)
 
 
 def param_specs(params: Mapping[str, Any]) -> dict[str, P]:
-    """PartitionSpec tree mirroring a QuantileGRU param dict."""
-    specs = {}
-    for name in params:
-        key = _rule_key(name)
-        if key not in _PARAM_SPECS:
-            raise KeyError(f"no sharding rule for parameter {name!r}")
-        specs[name] = _PARAM_SPECS[key]
-    return specs
+    """PartitionSpec dict mirroring a QuantileGRU param dict (the params
+    slice of the rule table; raises KeyError on an unmatched name)."""
+    return match_partition_rules(dict(params), strict=True)
+
+
+def state_specs(state: Any) -> Any:
+    """PartitionSpec pytree for a full TrainState (params, optimizer
+    mirrors, step/rng bookkeeping), strictly rule-resolved."""
+    return match_partition_rules(state, strict=True)
+
+
+def state_sharding(mesh: Mesh, state: Any) -> Any:
+    """NamedSharding pytree for a full TrainState on ``mesh`` — what the
+    trainer's ``pin_state`` constrains every step output to, and what
+    checkpoint restore assembles shards into."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        state_specs(state),
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def param_sharding(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, spec) for k, spec in param_specs(params).items()}
+    return {k: NamedSharding(mesh, spec)
+            for k, spec in param_specs(params).items()}
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
@@ -76,8 +150,6 @@ def shard_params(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, jax.Array]:
     only its addressable shards (``make_array_from_callback``) — init
     with the same PRNGKey makes every host's source params identical.
     """
-    import numpy as np
-
     shardings = param_sharding(mesh, params)
 
     def put(v, shd):

@@ -208,7 +208,8 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                         fused: bool = True,
                         page_windows: int | None = None,
                         coalesce_pages: int | None = None,
-                        coalesce_groups: int = 1) -> "Predictor":
+                        coalesce_groups: int = 1,
+                        mesh_config=None) -> "Predictor":
         """Restore params + host stats written by Trainer.save().
 
         With ``config=None`` the architecture comes wholesale from the
@@ -216,7 +217,17 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         it), so the restored predictor cannot drift from training.  An
         explicitly passed config is trusted as-is — the caller owns both
         architecture and serving knobs (compute_dtype, rnn_backend).
+
+        ``mesh_config`` (a MeshConfig or None) lays a serving device mesh
+        under the restored params: shardings resolve from the SAME
+        partition-rule table the trainer pins with
+        (parallel/sharding.PARTITION_RULES), so e.g. ``model=N`` gives the
+        serving ladder and fused engine feature-axis TP over the F that
+        grows with the endpoint vocabulary — there is no serving-side
+        spec list to drift from training's.  The checkpoint may have been
+        saved under any mesh shape (restore assembles by global index).
         """
+        from deeprest_tpu.parallel.mesh import make_mesh
         from deeprest_tpu.train.checkpoint import (
             latest_step, load_sidecar, restore_checkpoint,
         )
@@ -239,7 +250,9 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             config = Config(model=ModelConfig(**mc))
 
         metric_names = extra["metric_names"]
-        trainer = Trainer(config, extra["feature_dim"], metric_names)
+        mesh = make_mesh(mesh_config) if mesh_config is not None else None
+        trainer = Trainer(config, extra["feature_dim"], metric_names,
+                          mesh=mesh)
         target = trainer.init_state(
             np.zeros((1, extra["window_size"], extra["feature_dim"]), np.float32)
         )

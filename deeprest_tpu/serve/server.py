@@ -62,7 +62,8 @@ class CheckpointReloader:
                  ladder: tuple[int, ...] | None = None,
                  fused: bool = True, page_windows: int | None = None,
                  coalesce_pages: int | None = None,
-                 coalesce_groups: int = 1):
+                 coalesce_groups: int = 1,
+                 mesh_config=None):
         from deeprest_tpu.train.checkpoint import latest_step
 
         self.ckpt_dir = ckpt_dir
@@ -72,6 +73,7 @@ class CheckpointReloader:
         self.page_windows = page_windows
         self.coalesce_pages = coalesce_pages
         self.coalesce_groups = coalesce_groups
+        self.mesh_config = mesh_config   # ... and the serving mesh (TP)
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
         self._pending = None       # loaded Predictor awaiting pickup
@@ -118,7 +120,8 @@ class CheckpointReloader:
                 self.ckpt_dir, step=step, ladder=self.ladder,
                 fused=self.fused, page_windows=self.page_windows,
                 coalesce_pages=self.coalesce_pages,
-                coalesce_groups=self.coalesce_groups)
+                coalesce_groups=self.coalesce_groups,
+                mesh_config=self.mesh_config)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/
             # ValueError); anything else is logged but must never wedge

@@ -1,10 +1,33 @@
-"""Orbax checkpointing — the capability the reference lacks entirely
-(its model never touches disk; SURVEY.md §5.4).
+"""Sharding-aware checkpointing — the capability the reference lacks
+entirely (its model never touches disk; SURVEY.md §5.4).
 
 Checkpoints hold the full train state (params, optimizer state, step, rng)
 plus a JSON sidecar of host-side state that must survive restarts with it:
 normalization statistics, metric names, and the config — so a restored
 trainer predicts identically, not just resumes.
+
+Format (``deeprest-sharded-v1``): a ``manifest.json`` naming every pytree
+leaf (its "/"-joined path, global shape, dtype) plus the list of saved
+shard files with their global index ranges, and one ``.npy`` per distinct
+shard under ``arrays/``.  Writes are PER-SHARD: each leaf is written as
+its mesh shards (replica 0 only, so replicated leaves cost one copy), and
+on a multi-host pod each process writes only the shards it addresses —
+no host ever materializes another host's parameters.  Restore assembles
+whatever shard partitioning is on disk into the TARGET's shardings
+(``jax.make_array_from_callback``), so a state saved on a 2×2×2 mesh
+restores onto 1×1×1 or 8×1×1 (or a pod) unchanged: assembly is by global
+index, not by saved topology.  Target shardings come from the one
+partition-rule table (``parallel/sharding.py``) via the caller's
+``init_state`` template.
+
+Why not orbax: ``ocp.StandardCheckpointer`` drags in a grpc/aiohttp/
+tensorstore import chain that corrupts the glibc heap in this container
+(``corrupted size vs. prev_size`` aborts mid-train-step — the long-standing
+"orbax save abort" in ROADMAP item 1; the crash fired even in runs that
+never saved, because importing this module used to import orbax at module
+scope).  The native writer needs numpy + jax only.  Checkpoints written by
+older orbax-based builds still restore through a lazily-imported legacy
+path, so nothing on disk is orphaned.
 """
 
 from __future__ import annotations
@@ -12,32 +35,155 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any
+from typing import Any, Sequence
 
 import jax
-import orbax.checkpoint as ocp
+import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _SIDECAR = "host_state.json"
+_MANIFEST = "manifest.json"
+_FORMAT = "deeprest-sharded-v1"
+# numpy's .npy format round-trips these natively; anything else (ml_dtypes
+# extension types like bfloat16) is stored as a same-width integer view
+# with the real dtype recorded in the manifest.
+_NATIVE_DTYPES = frozenset("?bhilqBHILQefdFD")
 
 
 def _step_dir(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"step_{step:08d}")
 
 
+def _leaf_name(path: Sequence[Any]) -> str:
+    from deeprest_tpu.parallel.sharding import leaf_path_name
+
+    return leaf_path_name(path)
+
+
+def _norm_index(idx, shape) -> list[list[int]]:
+    """A devices_indices_map / callback index → concrete [[lo, hi], ...]."""
+    return [list(sl.indices(dim)[:2]) for sl, dim in zip(idx, shape)]
+
+
+def _shard_file(leaf_ord: int, name: str, lo: Sequence[int]) -> str:
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "-", name).strip("-") or "leaf"
+    start = "_".join(str(int(v)) for v in lo) if lo else "scalar"
+    return f"{leaf_ord:03d}.{safe}.{start}.npy"
+
+
+def _to_storage(arr: np.ndarray) -> tuple[np.ndarray, str]:
+    """(.npy-safe array, recorded dtype name).  bf16 & friends go to disk
+    as a same-width integer view."""
+    dtype_name = arr.dtype.name
+    if arr.dtype.char in _NATIVE_DTYPES:
+        return arr, dtype_name
+    width = {1: np.uint8, 2: np.uint16, 4: np.uint32,
+             8: np.uint64}[arr.dtype.itemsize]
+    return arr.view(width), dtype_name
+
+
+def _from_storage(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    if arr.dtype.name == dtype_name:
+        return arr
+    import ml_dtypes
+
+    # an integer-view round-trip (see _to_storage); the real dtype is an
+    # ml_dtypes extension type such as bfloat16
+    return arr.view(np.dtype(getattr(ml_dtypes, dtype_name)))
+
+
+def _leaf_shards(leaf) -> tuple[list[dict], list[tuple[list[list[int]], np.ndarray]]]:
+    """(manifest shard entries for ALL distinct shards, the [index, data]
+    pairs THIS process must write).
+
+    The manifest needs the full shard list; each process can compute it
+    locally from the sharding's ``devices_indices_map`` — no cross-host
+    traffic.  Replicated shards dedupe to one entry (replica 0 writes).
+    """
+    shape = tuple(np.shape(leaf))
+    if not isinstance(leaf, jax.Array) or not hasattr(leaf, "sharding"):
+        idx = _norm_index((slice(None),) * len(shape), shape)
+        return [{"index": idx}], [(idx, np.asarray(leaf))]
+    distinct: dict[tuple, list[list[int]]] = {}
+    for dev_idx in leaf.sharding.devices_indices_map(shape).values():
+        norm = _norm_index(dev_idx, shape)
+        distinct[tuple(tuple(p) for p in norm)] = norm
+    entries = [{"index": v} for v in distinct.values()]
+    mine = []
+    seen: set[tuple] = set()
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        key = tuple(tuple(p) for p in _norm_index(shard.index, shape))
+        if key in seen:
+            continue
+        seen.add(key)
+        mine.append((distinct[key], np.asarray(shard.data)))
+    return entries, mine
+
+
 def save_checkpoint(directory: str, state: Any, step: int,
                     extra: dict | None = None) -> str:
-    """Write ``directory/step_NNNNNNNN/`` (atomic via orbax) + sidecar."""
+    """Write ``directory/step_NNNNNNNN/`` (atomic: staged under ``.tmp``
+    then renamed) + sidecar, with per-shard array files.
+
+    On a pod every process calls this with the same global state; each
+    writes only its addressable replica-0 shards, processes sync, and
+    process 0 writes the manifest/sidecar and performs the rename.
+    """
     path = _step_dir(directory, step)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
-    if extra is not None:
-        # tmp + rename: a crash mid-write must leave no torn sidecar (a
-        # torn one would wedge every consumer that reads it at startup)
-        sidecar = os.path.join(path, _SIDECAR)
-        with open(sidecar + ".tmp", "w", encoding="utf-8") as f:
-            json.dump(extra, f, indent=2, sort_keys=True)
-        os.replace(sidecar + ".tmp", sidecar)
+    tmp = path + ".tmp"
+    arrays_dir = os.path.join(tmp, "arrays")
+    os.makedirs(arrays_dir, exist_ok=True)
+
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    leaves = []
+    for ord_, (leaf_path, leaf) in enumerate(flat):
+        name = _leaf_name(leaf_path)
+        entries, mine = _leaf_shards(leaf)
+        dtype_name = None
+        for idx, data in mine:
+            data = np.asarray(data)
+            if data.ndim and not data.flags["C_CONTIGUOUS"]:
+                # NOT np.ascontiguousarray: it silently promotes 0-d
+                # scalars to shape (1,), corrupting the manifest contract
+                data = np.ascontiguousarray(data)
+            stored, dtype_name = _to_storage(data)
+            fname = _shard_file(ord_, name, [lo for lo, _ in idx])
+            np.save(os.path.join(arrays_dir, fname), stored)
+        if dtype_name is None:       # no local shard: dtype from metadata
+            dtype_name = np.dtype(leaf.dtype).name
+        for e in entries:
+            e["file"] = _shard_file(ord_, name, [lo for lo, _ in e["index"]])
+        leaves.append({"name": name, "shape": list(np.shape(leaf)),
+                       "dtype": dtype_name, "shards": entries})
+
+    if jax.process_count() > 1:
+        # Every shard file must exist before the manifest claims it does
+        # (and before the atomic rename publishes the directory).
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deeprest_ckpt_shards_written")
+    if jax.process_index() == 0:
+        manifest = {"format": _FORMAT, "step": int(step), "leaves": leaves}
+        with open(os.path.join(tmp, _MANIFEST), "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        if extra is not None:
+            # tmp dir + final rename: a crash mid-write must leave no torn
+            # sidecar (a torn one would wedge every consumer that reads it
+            # at startup)
+            with open(os.path.join(tmp, _SIDECAR), "w",
+                      encoding="utf-8") as f:
+                json.dump(extra, f, indent=2, sort_keys=True)
+        if os.path.isdir(path):
+            import shutil
+
+            shutil.rmtree(path)      # force-overwrite an existing step
+        os.replace(tmp, path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("deeprest_ckpt_published")
     return path
 
 
@@ -64,8 +210,9 @@ def load_sidecar(directory: str, step: int | None = None,
     (for consumers that only need metadata: metric names, stats, config).
 
     ``missing_ok=True`` returns None for a sidecar that is absent *or
-    unparseable* (e.g. a crash between the orbax save and the sidecar
-    write, or torn by a pre-atomic-write version) instead of raising.
+    unparseable* (e.g. a crash between an array save and the sidecar
+    write in pre-atomic formats, or torn by a pre-atomic-write version)
+    instead of raising.
     """
     if step is None:
         step = latest_step(directory)
@@ -98,19 +245,142 @@ def prune_checkpoints(directory: str, keep: int) -> list[int]:
     return pruned
 
 
+def _intersect(a: list[list[int]], b: list[list[int]]):
+    """Overlap of two [[lo, hi], ...] boxes → (dst slices, src slices) or
+    None; dst is relative to box ``a``'s origin, src to box ``b``'s."""
+    dst, src = [], []
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if lo >= hi:
+            return None
+        dst.append(slice(lo - alo, hi - alo))
+        src.append(slice(lo - blo, hi - blo))
+    return tuple(dst), tuple(src)
+
+
+def _assemble(arrays_dir: str, entry: dict, idx, shape) -> np.ndarray:
+    """Materialize the requested global-index box of one saved leaf from
+    whatever shard partitioning is on disk (mmap'd, so replicated
+    restores of the same file stay cheap)."""
+    dtype = np.dtype(entry["dtype"])
+    box = _norm_index(idx, shape)
+    out_shape = tuple(hi - lo for lo, hi in box)
+    out = np.empty(out_shape, dtype)
+    filled = 0
+    for shard in entry["shards"]:
+        hit = _intersect(box, shard["index"])
+        if hit is None:
+            continue
+        dst, src = hit
+        data = np.load(os.path.join(arrays_dir, shard["file"]),
+                       mmap_mode="r")
+        out[dst] = _from_storage(np.asarray(data[src]), entry["dtype"])
+        filled += int(np.prod([s.stop - s.start for s in dst], dtype=np.int64))
+    if filled != int(np.prod(out_shape, dtype=np.int64)):
+        raise ValueError(
+            f"checkpoint leaf {entry['name']!r}: saved shards cover only "
+            f"{filled} of {int(np.prod(out_shape))} requested elements "
+            "(incomplete multi-host save?)")
+    return out
+
+
 def restore_checkpoint(directory: str, target: Any,
                        step: int | None = None) -> tuple[Any, dict | None]:
     """Restore the train state (sharded like ``target``) and the sidecar.
 
     ``target`` is a concrete or abstract state pytree (e.g. a freshly
-    initialized TrainState) defining structure, dtypes, and shardings.
+    initialized TrainState) defining structure, dtypes, and shardings —
+    typically rule-table shardings from ``Trainer.init_state`` under the
+    RESTORING mesh, which need not match the mesh the checkpoint was
+    saved under (assembly is by global index).
     """
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory!r}")
     path = _step_dir(directory, step)
+    manifest_path = os.path.join(path, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        return (_commit_to_device(_restore_legacy_orbax(path, target)),
+                load_sidecar(directory, step, missing_ok=True))
+    with open(manifest_path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    if manifest.get("format") != _FORMAT:
+        raise ValueError(f"unknown checkpoint format "
+                         f"{manifest.get('format')!r} at {path}")
+    arrays_dir = os.path.join(path, "arrays")
+    by_name = {e["name"]: e for e in manifest["leaves"]}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    out_leaves = []
+    for leaf_path, leaf in flat:
+        name = _leaf_name(leaf_path)
+        entry = by_name.get(name)
+        if entry is None:
+            raise KeyError(
+                f"checkpoint at {path} has no leaf {name!r} "
+                f"(saved leaves: {sorted(by_name)[:8]}...)")
+        shape = tuple(entry["shape"])
+        if shape != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint leaf {name!r} shape {shape} != target "
+                f"{tuple(np.shape(leaf))} (architecture drift?)")
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            arr = jax.make_array_from_callback(
+                shape, leaf.sharding,
+                lambda idx, e=entry, s=shape: _assemble(arrays_dir, e,
+                                                        idx, s))
+        else:
+            arr = _assemble(arrays_dir, entry,
+                            (slice(None),) * len(shape), shape)
+        out_leaves.append(arr)
+    state = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    return _commit_to_device(state), load_sidecar(directory, step,
+                                                  missing_ok=True)
+
+
+def _commit_to_device(state: Any) -> Any:
+    """Launder assembled arrays into XLA-owned buffers.
+
+    ``make_array_from_callback`` results stay backed by host (numpy)
+    buffers on the CPU backend; DONATING such a buffer into a compiled
+    step (the trainer donates the whole TrainState every step) frees
+    memory the host allocator still owns — measured in this container as
+    glibc heap corruption ("corrupted double-linked list" / "corrupted
+    size vs. prev_size") aborting mid-train after a restore.  One jitted
+    sharding-constraint pass re-materializes every leaf as an
+    XLA-allocated buffer with its target sharding intact; values are
+    bit-identical (it is the identity computation).
+    """
+    shardings = jax.tree.map(
+        lambda leaf: leaf.sharding
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding")
+        else None, state)
+
+    def pin(s):
+        return jax.tree.map(
+            lambda leaf, shd: (jax.lax.with_sharding_constraint(leaf, shd)
+                               if shd is not None else leaf),
+            s, shardings)
+
+    return jax.jit(pin)(state)
+
+
+def _restore_legacy_orbax(path: str, target: Any) -> Any:
+    """Checkpoints written by the pre-v1 orbax format (no manifest.json).
+
+    orbax is imported lazily and ONLY here: its grpc/aiohttp/tensorstore
+    import chain is the heap-corruption source the native format exists
+    to avoid, so the cost (and the risk) is paid exclusively by restores
+    of legacy directories.
+    """
+    try:
+        import orbax.checkpoint as ocp
+    except Exception as exc:  # pragma: no cover - env without orbax
+        raise RuntimeError(
+            f"checkpoint at {path} predates the native sharded format and "
+            f"orbax is unavailable to read it ({exc}); re-save it with a "
+            "build that has orbax installed") from exc
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
     with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(path, abstract)
-    return state, load_sidecar(directory, step, missing_ok=True)
+        return ckptr.restore(path, abstract)

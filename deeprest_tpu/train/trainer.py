@@ -32,7 +32,7 @@ from deeprest_tpu.parallel.distributed import (
     feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
 )
 from deeprest_tpu.parallel.mesh import make_mesh
-from deeprest_tpu.parallel.sharding import param_specs, shard_params
+from deeprest_tpu.parallel.sharding import shard_params, state_sharding
 from deeprest_tpu.train.data import DatasetBundle, eval_window_indices
 from deeprest_tpu.train.metrics import Throughput, mae_report
 
@@ -77,9 +77,11 @@ class Trainer:
         quantiles = self.model_config.quantiles
 
         def pin_state(state: TrainState) -> TrainState:
-            """Constrain every leaf to its CANONICAL named sharding: params
-            (and their optimizer mirrors, keyed by the same names) per
-            param_specs, everything else replicated.
+            """Constrain every leaf to its CANONICAL named sharding, all
+            resolved from the ONE rule table (parallel/sharding.py
+            PARTITION_RULES — params, their optimizer mirrors, and the
+            replicated step/rng bookkeeping; strict mode errors at trace
+            time on any leaf the table does not place).
 
             Without this, GSPMD collapses the output params' specs (e.g.
             P('expert', None) → P() on a trivial mesh axis) and flips
@@ -91,20 +93,8 @@ class Trainer:
             per step function (the no-recompile probe) and is what makes
             the superstep scan bit-identical to the per-step loop.
             """
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            pspecs = param_specs(state.params)
-
-            def pin(path, leaf):
-                name = next((p.key for p in reversed(path)
-                             if isinstance(p, jax.tree_util.DictKey)), None)
-                spec = pspecs.get(name)
-                if spec is None or len(spec) != leaf.ndim:
-                    spec = P()
-                return jax.lax.with_sharding_constraint(
-                    leaf, NamedSharding(self.mesh, spec))
-
-            return jax.tree_util.tree_map_with_path(pin, state)
+            return jax.tree.map(jax.lax.with_sharding_constraint,
+                                state, state_sharding(self.mesh, state))
 
         self._pin_state = jax.jit(pin_state)
 

@@ -202,6 +202,57 @@ def drain(xs):
 
 
 # ---------------------------------------------------------------------------
+# JX005: NamedSharding literals outside parallel/sharding.py
+
+
+JX005_BAD = """
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+def pin(mesh, leaf):
+    return jax.lax.with_sharding_constraint(
+        leaf, NamedSharding(mesh, P("expert", None)))
+"""
+
+JX005_GOOD = """
+from deeprest_tpu.parallel.sharding import state_sharding
+
+def pin(mesh, state):
+    return jax.tree.map(jax.lax.with_sharding_constraint,
+                        state, state_sharding(mesh, state))
+"""
+
+
+def test_jx005_pair():
+    assert_pair("JX005", JX005_BAD, JX005_GOOD,
+                rel="train/trainer.py")
+
+
+def test_jx005_silent_in_the_table_owner_module():
+    # the one module allowed to construct NamedSharding, under both
+    # lint-root-relative spellings
+    for rel in ("parallel/sharding.py", "deeprest_tpu/parallel/sharding.py"):
+        assert not findings_for("JX005", JX005_BAD, rel=rel)
+
+
+def test_jx005_dotted_constructor_and_suppression():
+    bad = """
+import jax
+
+def feed(mesh, arr):
+    return jax.device_put(arr, jax.sharding.NamedSharding(mesh, P()))
+"""
+    assert findings_for("JX005", bad, rel="serve/predictor.py")
+    suppressed = """
+import jax
+
+def feed(mesh, arr):
+    # graftlint: disable=JX005 -- designed feed-path site: input placement
+    return jax.device_put(arr, jax.sharding.NamedSharding(mesh, P()))
+"""
+    assert not findings_for("JX005", suppressed, rel="serve/predictor.py")
+
+
+# ---------------------------------------------------------------------------
 # JX004: use-after-donation
 
 
