@@ -11,6 +11,11 @@
 #                         reproducible in a few minutes; refreshes
 #                         MULTICHIP_r06.json — the real curve rides
 #                         benchmarks/tpu_queue.sh)
+#   make serve-bench-replicas
+#                         the serving-plane replica sweep (routing front,
+#                         admission, concurrency up to 1024) — refreshes
+#                         benchmarks/serve_bench.json; the hardware
+#                         scaling curve rides benchmarks/tpu_queue.sh
 
 PYTHON ?= python
 
@@ -26,4 +31,7 @@ tsan:
 bench-multichip:
 	$(PYTHON) bench.py --mesh --quick --out MULTICHIP_r06.json
 
-.PHONY: lint native tsan bench-multichip
+serve-bench-replicas:
+	$(PYTHON) benchmarks/serve_bench.py --out benchmarks/serve_bench.json
+
+.PHONY: lint native tsan bench-multichip serve-bench-replicas

@@ -81,6 +81,15 @@ step superstep_sweep 1800 python benchmarks/superstep_sweep.py --flagship \
 # sharded feed + rule-table path on hardware.
 step multichip_sweep 2700 python benchmarks/multichip_sweep.py \
   --out benchmarks/multichip_tpu_r06.json
+# Serving-plane replica sweep (round 13): the CPU run proves the routing/
+# admission plumbing but is device-contention-capped at 1 core
+# (serve_bench.json honest_cpu); on hardware, replicas pin to distinct
+# chips and the aggregate-rps-vs-R curve is real.  Requires no trained
+# model (random-init predictor) so it can ride any tunnel window.
+step serve_bench_replicas 2400 env JAX_PLATFORMS=tpu python \
+  benchmarks/serve_bench.py --replicas 1,2,4 \
+  --replica-concurrency 16,64,256,1024 \
+  --out benchmarks/serve_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -117,7 +117,14 @@ class ClassModel:
             self.units[m.name] = unit
         method_names = {m.name for m in methods}
         for m in methods:
-            self_name = (m.args.args[0].arg if m.args.args else "self")
+            # a staticmethod's first arg is NOT the instance — scanning it
+            # as "self" fabricates attribute accesses (the ReplicaRouter
+            # _probe_meta false positive)
+            if any(isinstance(d, ast.Name) and d.id == "staticmethod"
+                   for d in m.decorator_list):
+                self_name = ""
+            else:
+                self_name = (m.args.args[0].arg if m.args.args else "self")
             unit = self.units[m.name]
             local_thread_fns = self._local_thread_targets(m)
             self._scan_body(m, unit, self_name,
@@ -411,6 +418,156 @@ class TH001AttributeRace(Rule):
                     and n.lineno > spawn_line):
                 return n.lineno
         return None
+
+
+# -- TH003: state mutated across a multiprocessing boundary ----------------
+
+
+def _is_process_ctor(call: ast.Call) -> bool:
+    name = call_name(call.func)
+    return name == "Process" or bool(name and name.endswith(".Process"))
+
+
+@register
+class TH003CrossProcessState(Rule):
+    id = "TH003"
+    title = ("self.* state mutated inside a multiprocessing child is "
+             "invisible to the parent process")
+    guards = ("the replica plane runs worker subprocesses "
+              "(serve/replica.ProcessReplica); a counter updated via "
+              "self.* in the child lives in the child's copy of the "
+              "object — the router's scheduler would read frozen parent "
+              "state forever.  Share through the Pipe/Queue/Value the "
+              "worker protocol already carries")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check(sf, node)
+
+    def _check(self, sf: SourceFile,
+               cnode: ast.ClassDef) -> Iterator[Finding]:
+        model = ClassModel(sf, cnode, False)
+        methods = [n for n in cnode.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        method_names = {m.name for m in methods}
+        # methods handed to a Process ctor as target=self.<m>
+        child_entries: set[str] = set()
+        for m in methods:
+            self_name = (m.args.args[0].arg if m.args.args else "self")
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call) and _is_process_ctor(n):
+                    tgt = _thread_target(n)
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == self_name
+                            and tgt.attr in method_names):
+                        child_entries.add(tgt.attr)
+        if not child_entries:
+            return
+        # transitive: self.M() calls from child-side units stay child-side
+        child_units = set(child_entries)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(child_units):
+                u = model.units.get(name)
+                if u is None:
+                    continue
+                for callee in u.self_calls:
+                    if callee in method_names and callee not in child_units:
+                        child_units.add(callee)
+                        changed = True
+        for uname in sorted(child_units):
+            u = model.units.get(uname)
+            if u is None:
+                continue
+            for acc in u.accesses:
+                if not acc.write:
+                    continue
+                readers = [
+                    a for other, ou in model.units.items()
+                    if other not in child_units and other != "__init__"
+                    for a in ou.accesses if a.attr == acc.attr
+                ]
+                if not readers:
+                    continue
+                r = readers[0]
+                yield sf.finding(
+                    acc.line, "TH003",
+                    f"{cnode.name}.{acc.attr} is written in {uname}() — a "
+                    f"multiprocessing child entry — and read parent-side "
+                    f"in {r.unit}() line {r.line}; the child mutates its "
+                    "OWN copy of the object, so the parent never observes "
+                    "this write.  Route it through the process boundary "
+                    "explicitly (Pipe/Queue/Value/shared memory)")
+                break          # one finding per child-written attribute
+
+
+# -- TH004: inconsistent lock discipline ------------------------------------
+
+
+@register
+class TH004LockDiscipline(Rule):
+    id = "TH004"
+    title = ("attribute guarded by the class's lock on one side but "
+             "written or read without it elsewhere")
+    guards = ("the routing front's shared surfaces (replica registry, "
+              "admission counters, autoscaler sample ring) are called "
+              "from HTTP handler threads in OTHER modules, where TH001's "
+              "thread-entry proof cannot see; mixing one unguarded "
+              "access into an otherwise lock-guarded attribute "
+              "re-introduces exactly the races TH001 exists to stop")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check(sf, node)
+
+    def _check(self, sf: SourceFile,
+               cnode: ast.ClassDef) -> Iterator[Finding]:
+        model = ClassModel(sf, cnode, False)
+        if not model.lock_attrs:
+            return
+        method_names = {n.name for n in cnode.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        for attr in sorted(model.written_outside_init):
+            if attr in method_names:
+                continue                   # bound methods, not state
+            # convention: a *_locked method is called with the class lock
+            # already held — its accesses count as guarded
+            accesses = [a for u in model.units.values() for a in u.accesses
+                        if a.attr == attr and u.name != "__init__"]
+            locked = [a for a in accesses
+                      if a.locked or a.unit.endswith("_locked")]
+            unlocked = [a for a in accesses
+                        if not (a.locked or a.unit.endswith("_locked"))]
+            if not locked or not unlocked:
+                continue                   # consistent either way
+            # inconsistent AND write-involved: an unguarded write against
+            # any guarded access, or an unguarded read of a
+            # guarded-written attribute
+            bad = next((a for a in unlocked if a.write), None)
+            if bad is None and any(a.write for a in locked):
+                bad = unlocked[0]
+            if bad is None:
+                continue
+            witness = locked[0]
+            yield sf.finding(
+                bad.line, "TH004",
+                f"{cnode.name}.{attr} is "
+                f"{'written' if bad.write else 'read'} in {bad.unit}() "
+                f"without the class lock, but {witness.unit}() line "
+                f"{witness.line} guards the same attribute with "
+                f"self.{sorted(model.lock_attrs)[0]} — one unguarded "
+                "access defeats the lock; hold it on every access")
 
 
 # -- TH002: lock-ordering cycles -------------------------------------------

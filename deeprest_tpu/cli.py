@@ -557,10 +557,56 @@ def cmd_export(args) -> int:
     return 0
 
 
+def _parse_tenant_weights(spec: str | None) -> dict[str, float] | None:
+    """``a=3,b=1`` → {"a": 3.0, "b": 1.0} (None/empty → None)."""
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(","):
+        name, _, w = part.partition("=")
+        try:
+            out[name.strip()] = float(w)
+        except ValueError:
+            raise ValueError(f"bad --tenant-weights entry {part!r} "
+                             "(want name=weight)") from None
+        if not name.strip() or out[name.strip()] <= 0:
+            raise ValueError(f"bad --tenant-weights entry {part!r} "
+                             "(weight must be > 0)")
+    return out
+
+
+def _load_autoscaler_module():
+    """deploy/autoscaler.py is deployment-plane code living next to the
+    manifests it rewrites; load it by path from the repo layout."""
+    import importlib.util
+    import os
+
+    import deeprest_tpu
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(
+            deeprest_tpu.__file__))), "deploy", "autoscaler.py")
+    if not os.path.isfile(path):
+        sys.exit(f"error: autoscaler module not found at {path}")
+    spec = importlib.util.spec_from_file_location("deeprest_autoscaler", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: the module's @dataclass decorators resolve
+    # sys.modules[cls.__module__] at class-creation time (py3.10)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def cmd_serve(args) -> int:
     """Serve predict / what-if / anomaly over HTTP from a checkpoint or an
     exported artifact (serve/server.py), with cross-request micro-batching
-    on by default (serve/batcher.py; disable with --no-batcher)."""
+    on by default (serve/batcher.py; disable with --no-batcher).  With
+    --replicas N the backend becomes a routing front over N engine
+    replicas (serve/router.py): least-outstanding-work dispatch, bounded
+    admission (--admission-depth → fast 429 + Retry-After), per-tenant
+    weighted round-robin on the X-Tenant header, zero-downtime rolling
+    reload under --watch, and an optional self-sizing control loop
+    (--autoscale, deploy/autoscaler.py)."""
     from deeprest_tpu.serve.batcher import BatcherConfig
     from deeprest_tpu.serve.server import (
         CheckpointReloader, PredictionServer, PredictionService,
@@ -632,6 +678,53 @@ def cmd_serve(args) -> int:
             coalesce_groups=args.batch_coalesce_groups)
         backend = f"artifact:{args.artifact}"
 
+    # -- multi-replica routing front (serve/router.py) -------------------
+    autoscaler = None
+    if args.replicas > 1 or args.admission_depth or args.tenant_weights:
+        from deeprest_tpu.serve.router import ReplicaRouter, RouterConfig
+
+        try:
+            weights = _parse_tenant_weights(args.tenant_weights)
+        except ValueError as exc:
+            sys.exit(f"error: {exc}")
+        router_cfg = RouterConfig(
+            admission_depth=args.admission_depth or 64,
+            max_wait_s=args.admission_wait_ms / 1e3,
+            retry_after_s=args.admission_retry_after_ms / 1e3,
+            tenant_weights=weights)
+        if args.replica_mode == "process":
+            if not (args.ckpt_dir or args.artifact):
+                sys.exit("error: --replica-mode=process needs --ckpt-dir "
+                         "or --artifact (workers rebuild their own stacks)")
+            spec = {"ckpt_dir": args.ckpt_dir, "artifact": args.artifact,
+                    "kwargs": {"ladder": ladder,
+                               "fused": not args.no_fused_infer,
+                               "page_windows": args.infer_page_windows,
+                               "coalesce_pages": args.infer_coalesce_pages,
+                               "coalesce_groups":
+                                   args.batch_coalesce_groups}}
+            pred = ReplicaRouter.build_process(
+                spec, args.replicas, config=router_cfg, batching=batching)
+        else:
+            pred = ReplicaRouter.build(
+                pred, args.replicas, config=router_cfg, batching=batching)
+        batching = None          # the router owns per-replica batchers
+        backend = f"{backend} x{args.replicas} ({args.replica_mode})"
+
+        if args.autoscale:
+            mod = _load_autoscaler_module()
+            autoscaler = mod.Autoscaler(
+                pred,
+                mod.AutoscalerConfig(
+                    min_replicas=args.autoscale_min,
+                    max_replicas=args.autoscale_max,
+                    interval_s=args.autoscale_interval,
+                    capacity_rps_per_replica=args.autoscale_rps_per_replica),
+                manifest_path=args.autoscale_manifest or None).start()
+    elif args.autoscale:
+        sys.exit("error: --autoscale needs --replicas > 1 (the router is "
+                 "the autoscaler's actuator)")
+
     synthesizer = None
     if args.raw:
         from deeprest_tpu.data.synthesize import TraceSynthesizer
@@ -649,19 +742,25 @@ def cmd_serve(args) -> int:
     print(json.dumps({"listening": f"http://{host}:{port}",
                       "backend": backend,
                       "whatif": synthesizer is not None,
-                      "batching": (None if batching is None else {
-                          "max_batch": batching.max_batch,
-                          "max_linger_ms": batching.max_linger_s * 1e3,
+                      "replicas": args.replicas,
+                      "autoscale": autoscaler is not None,
+                      "batching": (None if args.no_batcher else {
+                          "max_batch": args.batch_max_windows,
+                          "max_linger_ms": args.batch_linger_ms,
                           "ladder": list(ladder),
                       })}), flush=True)
-    if args.deadline:
-        server.start()
-        import time as _time
+    try:
+        if args.deadline:
+            server.start()
+            import time as _time
 
-        _time.sleep(args.deadline)
-        server.stop()
-    else:
-        server.serve_forever()
+            _time.sleep(args.deadline)
+            server.stop()
+        else:
+            server.serve_forever()
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
     return 0
 
 
@@ -1097,6 +1196,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "recurrence rows at the default ladder) instead "
                         "of G sequential top-rung dispatches; raise "
                         "--batch-max-windows to match")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="engine replicas behind the routing front "
+                        "(serve/router.py): each a full Predictor/"
+                        "MicroBatcher/fused-engine stack pinned to its own "
+                        "device (replicas sharing a device share one "
+                        "stack), dispatched least-outstanding-work; 1 = "
+                        "today's single-engine path")
+    p.add_argument("--replica-mode", choices=("thread", "process"),
+                   default="thread",
+                   help="replica isolation: in-process threads (default) "
+                        "or worker subprocesses that each rebuild the "
+                        "full stack from --ckpt-dir/--artifact")
+    p.add_argument("--admission-depth", type=int, default=0, metavar="N",
+                   help="max concurrently admitted requests across the "
+                        "plane; beyond it (plus a same-size bounded wait "
+                        "queue) requests fail fast with 429 + Retry-After "
+                        "instead of queueing into collapse (0 = default "
+                        "64 when the router is on)")
+    p.add_argument("--admission-wait-ms", type=float, default=250.0,
+                   help="max time a request may wait in the fairness "
+                        "queue for a slot before the 429")
+    p.add_argument("--admission-retry-after-ms", type=float, default=50.0,
+                   help="Retry-After hint sent with admission 429s")
+    p.add_argument("--tenant-weights", default=None, metavar="a=3,b=1",
+                   help="weighted round-robin shares per X-Tenant header "
+                        "value (unknown tenants weigh 1)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the self-sizing control loop "
+                        "(deploy/autoscaler.py): observed traffic -> "
+                        "what-if capacity estimate -> router.scale_to; "
+                        "decisions surface on /healthz under "
+                        "router.autoscaler")
+    p.add_argument("--autoscale-min", type=int, default=1)
+    p.add_argument("--autoscale-max", type=int, default=8)
+    p.add_argument("--autoscale-interval", type=float, default=10.0,
+                   help="control-tick seconds")
+    p.add_argument("--autoscale-rps-per-replica", type=float, default=None,
+                   help="measured per-replica capacity basis (rps; the "
+                        "committed serve_bench headline is the honest "
+                        "source)")
+    p.add_argument("--autoscale-manifest", default=None, metavar="PATH",
+                   help="mirror decisions into this k8s manifest's "
+                        "deeprest-predictor Deployment spec.replicas "
+                        "(deploy/k8s/predictor.yaml)")
     _add_fused_infer_args(p)
     _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_serve)

@@ -15,6 +15,12 @@ from deeprest_tpu.serve.export import ExportedPredictor, export_predictor
 from deeprest_tpu.serve.server import (
     CheckpointReloader, PredictionServer, PredictionService, ServingError,
 )
+from deeprest_tpu.serve.replica import (
+    EngineReplica, ProcessReplica, clone_backend,
+)
+from deeprest_tpu.serve.router import (
+    AdmissionError, ReplicaRouter, RouterConfig,
+)
 
 __all__ = [
     "BatcherConfig",
@@ -33,4 +39,10 @@ __all__ = [
     "PredictionServer",
     "PredictionService",
     "ServingError",
+    "EngineReplica",
+    "ProcessReplica",
+    "clone_backend",
+    "AdmissionError",
+    "ReplicaRouter",
+    "RouterConfig",
 ]

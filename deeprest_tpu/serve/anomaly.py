@@ -82,7 +82,23 @@ class AnomalyDetector:
         dm = getattr(self.predictor, "delta_mask", None)
         preds = self.predictor.predict_series(
             traffic, integrate=False)                       # [T, E, Q]
-        med = self.predictor.median_index()
+        # Monotone quantile rearrangement (Chernozhukov/Fernández-Val/
+        # Galichon): sort the quantile axis so the band edge is the upper
+        # ENVELOPE of the predicted quantiles.  The heads are trained
+        # independently under pinball loss and can cross — an undertrained
+        # upper head can sit at the normalized clamp floor, BELOW the
+        # median — and ``preds[..., -1]`` then reads the band's floor as
+        # its ceiling: every ordinary observation becomes "excess" and the
+        # detector false-flags from the first buckets (the flag_at=7
+        # incident; tests/test_serve.py pins flag_at inside the injected
+        # anomaly window).  Rearrangement restores valid, non-crossing
+        # quantiles without touching the wire predictions.
+        preds = np.sort(np.asarray(preds, np.float32), axis=-1)
+        # after value-sorting, quantile level i lives at its RANK among
+        # the configured levels (identity for the ascending default)
+        qs = list(self.predictor.quantiles)
+        med = sorted(range(len(qs)), key=lambda i: qs[i]).index(
+            self.predictor.median_index())
         observed = np.array(observed, np.float32, copy=True)
         reanchored: list[int] = []
         for e, metric in enumerate(self.predictor.metric_names):

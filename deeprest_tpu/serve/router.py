@@ -1,0 +1,604 @@
+"""The routing front: least-outstanding-work dispatch, admission control,
+per-tenant fairness, and zero-downtime rolling reload over engine replicas.
+
+Clipper's split (PAPERS.md [2]): model containers stay dumb and
+replicated; the routing layer owns batching policy, admission, and the
+latency SLO.  Here the containers are :mod:`serve/replica.py` stacks and
+this router IS the serving backend the HTTP service sees — it exposes the
+same protocol as a single Predictor (``predict_series``,
+``predict_series_many``, metadata, ``space``), so PredictionService and
+every consumer (WhatIfEstimator, AnomalyDetector) run unchanged on one
+engine or on forty.
+
+Policies:
+
+- **Dispatch** — least outstanding work: each request goes to the live
+  replica with the fewest windows currently in flight (ties resolve
+  round-robin).  Window counts, not request counts: one what-if sweep can
+  carry 100× the windows of a single-window predict.
+- **Admission** — a bounded global in-flight depth.  Beyond it, requests
+  FAIL FAST with 429 + ``Retry-After`` instead of queueing into collapse
+  (the closed-loop serve_bench at concurrency 1024 pins p99 staying
+  bounded).  A small bounded wait absorbs micro-bursts; the queue itself
+  is also bounded.
+- **Fairness** — smooth weighted round-robin over the ``X-Tenant`` key.
+  When slots free up, waiting tenants are granted in WRR order, so a
+  tenant flooding the plane cannot starve the others beyond its weight
+  share; unknown tenants get weight 1.
+- **Rolling reload** — drain one replica at a time, swap its stack, and
+  re-admit it before touching the next.  A request is served end-to-end
+  by the single backend its replica held at dispatch, so no response ever
+  mixes old and new params (pinned by tests/test_router.py under live
+  load).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+
+import numpy as np
+
+from deeprest_tpu.serve.replica import EngineReplica, clone_backend
+from deeprest_tpu.serve.server import ServingError
+
+
+class AdmissionError(ServingError):
+    """The plane is saturated: fast 429 with a Retry-After hint."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message, status=429,
+                         headers={"Retry-After": f"{retry_after_s:.3f}"})
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Admission/fairness knobs for :class:`ReplicaRouter`.
+
+    ``admission_depth`` bounds concurrently ADMITTED requests across the
+    whole plane; ``max_waiting`` bounds the short fairness queue behind it
+    (everything beyond fails fast).  ``max_wait_s`` is how long a request
+    may sit in that queue before it too turns into a 429 — the knob that
+    keeps p99 bounded instead of collapsing under overload.
+    """
+
+    admission_depth: int = 64
+    max_waiting: int | None = None        # default: == admission_depth
+    max_wait_s: float = 0.25
+    retry_after_s: float = 0.05
+    tenant_weights: dict[str, float] | None = None
+    default_tenant: str = "default"
+
+    def __post_init__(self):
+        if self.admission_depth < 1:
+            raise ValueError(
+                f"admission_depth {self.admission_depth} must be >= 1")
+        if self.max_waiting is not None and self.max_waiting < 0:
+            raise ValueError(f"max_waiting {self.max_waiting} must be >= 0")
+        if self.max_wait_s < 0 or self.retry_after_s < 0:
+            raise ValueError("max_wait_s/retry_after_s must be >= 0")
+        for t, w in (self.tenant_weights or {}).items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r} weight {w} must be > 0")
+
+    @property
+    def waiting_bound(self) -> int:
+        return (self.admission_depth if self.max_waiting is None
+                else self.max_waiting)
+
+
+class _Waiter:
+    __slots__ = ("event", "granted")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.granted = False
+
+
+class WeightedAdmission:
+    """Bounded in-flight slots granted in smooth-WRR order per tenant.
+
+    Smooth weighted round-robin (the nginx algorithm): each grant adds
+    every waiting tenant's weight to its credit, picks the max-credit
+    tenant, and charges it the total active weight — over time grants
+    converge to the weight ratio, without bursts.
+    """
+
+    def __init__(self, config: RouterConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._waiting: dict[str, collections.deque[_Waiter]] = {}
+        self._credit: dict[str, float] = {}
+        self._stats = {"admitted": 0, "rejected": 0, "queued": 0}
+        self._tenant_stats: dict[str, dict[str, int]] = {}
+        # IN-PLANE latency window (admission grant → response written):
+        # the portion of request latency the admission bound actually
+        # controls — client-observed latency additionally carries the
+        # HTTP layer's thread scheduling, which no admission policy can
+        # cap on a saturated host.
+        self._latencies: collections.deque[float] = collections.deque(
+            maxlen=8192)
+
+    def _weight(self, tenant: str) -> float:
+        return (self.config.tenant_weights or {}).get(tenant, 1.0)
+
+    def _tstat(self, tenant: str) -> dict:
+        return self._tenant_stats.setdefault(
+            tenant, {"admitted": 0, "rejected": 0})
+
+    def try_acquire(self, tenant: str | None) -> "_AdmissionTicket":
+        cfg = self.config
+        tenant = tenant or cfg.default_tenant
+        waiter = None
+        with self._lock:
+            if self._inflight < cfg.admission_depth and not any(
+                    self._waiting.values()):
+                self._inflight += 1
+                self._stats["admitted"] += 1
+                self._tstat(tenant)["admitted"] += 1
+                return _AdmissionTicket(self, tenant)
+            total_waiting = sum(len(q) for q in self._waiting.values())
+            if cfg.max_wait_s <= 0 or total_waiting >= cfg.waiting_bound:
+                self._stats["rejected"] += 1
+                self._tstat(tenant)["rejected"] += 1
+                raise AdmissionError(
+                    f"serving plane saturated ({self._inflight} in flight, "
+                    f"{total_waiting} waiting); retry after "
+                    f"{cfg.retry_after_s:.3f}s", cfg.retry_after_s)
+            waiter = _Waiter()
+            self._waiting.setdefault(tenant, collections.deque()).append(
+                waiter)
+            self._stats["queued"] += 1
+        waiter.event.wait(cfg.max_wait_s)
+        with self._lock:
+            if waiter.granted:
+                self._stats["admitted"] += 1
+                self._tstat(tenant)["admitted"] += 1
+                return _AdmissionTicket(self, tenant)
+            # timed out: withdraw from the queue (the grant path may race
+            # us — granted wins, checked again under the lock above)
+            q = self._waiting.get(tenant)
+            if q is not None and waiter in q:
+                q.remove(waiter)
+                if not q:
+                    del self._waiting[tenant]
+            if waiter.granted:          # grant landed between wait and lock
+                self._stats["admitted"] += 1
+                self._tstat(tenant)["admitted"] += 1
+                return _AdmissionTicket(self, tenant)
+            self._stats["rejected"] += 1
+            self._tstat(tenant)["rejected"] += 1
+        raise AdmissionError(
+            f"serving plane saturated (waited {cfg.max_wait_s:.3f}s); "
+            f"retry after {cfg.retry_after_s:.3f}s", cfg.retry_after_s)
+
+    def release(self, in_plane_s: float | None = None) -> None:
+        with self._lock:
+            self._inflight -= 1
+            if in_plane_s is not None:
+                self._latencies.append(in_plane_s)
+            self._grant_next_locked()
+
+    def reset_window(self) -> None:
+        """Start a fresh in-plane latency window (bench cell boundary)."""
+        with self._lock:
+            self._latencies.clear()
+
+    def _grant_next_locked(self) -> None:
+        cfg = self.config
+        while (self._inflight < cfg.admission_depth
+               and any(self._waiting.values())):
+            active = [t for t, q in self._waiting.items() if q]
+            total = sum(self._weight(t) for t in active)
+            best = None
+            for t in active:
+                self._credit[t] = self._credit.get(t, 0.0) + self._weight(t)
+                if best is None or self._credit[t] > self._credit[best]:
+                    best = t
+            self._credit[best] -= total
+            waiter = self._waiting[best].popleft()
+            if not self._waiting[best]:
+                del self._waiting[best]
+            waiter.granted = True
+            self._inflight += 1
+            waiter.event.set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            lats = sorted(self._latencies)
+            out = {
+                "depth": self.config.admission_depth,
+                "inflight": self._inflight,
+                "waiting": sum(len(q) for q in self._waiting.values()),
+                **self._stats,
+                "tenants": {t: dict(s)
+                            for t, s in sorted(self._tenant_stats.items())},
+            }
+
+        def pct(p):
+            if not lats:
+                return None
+            k = min(len(lats) - 1, int(round(p / 100 * (len(lats) - 1))))
+            return round(1e3 * lats[k], 3)
+
+        out["in_plane_p50_ms"] = pct(50)
+        out["in_plane_p99_ms"] = pct(99)
+        return out
+
+
+class _AdmissionTicket:
+    """Context manager covering one admitted request end-to-end; its
+    lifetime is the request's IN-PLANE latency sample."""
+
+    __slots__ = ("_admission", "tenant", "_t0")
+
+    def __init__(self, admission: WeightedAdmission, tenant: str):
+        import time
+
+        self._admission = admission
+        self.tenant = tenant
+        self._t0 = time.monotonic()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self._admission.release(in_plane_s=time.monotonic() - self._t0)
+        return False
+
+
+class ReplicaRouter:
+    """N replicas behind the single-predictor serving protocol."""
+
+    def __init__(self, replicas: list, config: RouterConfig | None = None,
+                 batching=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.config = config or RouterConfig()
+        self.admission = WeightedAdmission(self.config)
+        # Guards the replica registry (autoscaler grows/shrinks it and the
+        # rolling reload flips drain states while handler threads pick
+        # replicas) and the counters below.
+        self._lock = threading.Lock()
+        self._replicas = list(replicas)
+        self._rr = 0                   # round-robin tiebreak cursor
+        self._reloads = 0
+        self._dispatched = 0
+        self._batching = batching
+        self._autoscaler_decision: dict | None = None
+        self._meta = self._probe_meta(replicas[0])
+
+    @staticmethod
+    def _probe_meta(replica) -> dict:
+        backend = getattr(replica, "backend", None)
+        if callable(backend):
+            b = backend()
+            return {
+                "metric_names": list(b.metric_names),
+                "window_size": b.window_size,
+                "feature_dim": b.feature_dim,
+                "quantiles": tuple(b.quantiles),
+                "median_index": b.median_index(),
+                "delta_mask": (np.asarray(b.delta_mask, bool)
+                               if b.delta_mask is not None else None),
+                "space_dict": getattr(b, "space_dict", None),
+            }
+        meta = replica._meta            # ProcessReplica boot handshake
+        return {
+            "metric_names": list(meta["metric_names"]),
+            "window_size": int(meta["window_size"]),
+            "feature_dim": int(meta["feature_dim"]),
+            "quantiles": tuple(meta["quantiles"]),
+            "median_index": int(meta["median_index"]),
+            "delta_mask": (np.asarray(meta["delta_mask"], bool)
+                           if meta.get("delta_mask") is not None else None),
+            "space_dict": meta.get("space_dict"),
+        }
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def build(cls, backend, n: int, config: RouterConfig | None = None,
+              batching=None, devices=None) -> "ReplicaRouter":
+        """N in-process replicas over ``backend``, round-robin across
+        ``devices`` (default: every attached device).  Replicas landing on
+        the SAME device share one stack — executables are per-device, so
+        replica count beyond the device count adds scheduling slots, not
+        compiles (pinned by tests/test_router.py)."""
+        import jax
+
+        if n < 1:
+            raise ValueError(f"replica count {n} must be >= 1")
+        if devices is None:
+            devices = list(jax.devices())
+        from deeprest_tpu.serve.batcher import MicroBatcher
+
+        by_device: dict[int, object] = {}
+        replicas = []
+        for i in range(n):
+            dev = devices[i % len(devices)]
+            key = id(dev)
+            stack = by_device.get(key)
+            if stack is None:
+                stack = (backend if not by_device
+                         else clone_backend(backend, device=dev))
+                if batching is not None and stack.batcher is None:
+                    stack.attach_batcher(MicroBatcher(stack.ladder,
+                                                      batching))
+                by_device[key] = stack
+            replicas.append(EngineReplica(stack, name=f"r{i}", device=dev,
+                                          batching=batching))
+        return cls(replicas, config=config, batching=batching)
+
+    @classmethod
+    def build_process(cls, spec: dict, n: int,
+                      config: RouterConfig | None = None,
+                      batching=None) -> "ReplicaRouter":
+        """N worker-subprocess replicas from one spec (each child builds
+        and owns its full stack; see serve/replica.ProcessReplica)."""
+        from deeprest_tpu.serve.replica import ProcessReplica
+
+        if n < 1:
+            raise ValueError(f"replica count {n} must be >= 1")
+        if batching is not None:
+            spec = dict(spec)
+            spec["batching"] = {"max_batch": batching.max_batch,
+                               "max_linger_s": batching.max_linger_s,
+                               "max_queue": batching.max_queue}
+        replicas = [ProcessReplica(spec, name=f"p{i}") for i in range(n)]
+        return cls(replicas, config=config, batching=batching)
+
+    # -- serving protocol (what PredictionService consumes) --------------
+
+    def _meta_get(self, key: str):
+        with self._lock:       # a rolling reload re-probes self._meta
+            return self._meta[key]
+
+    @property
+    def metric_names(self) -> list[str]:
+        return self._meta_get("metric_names")
+
+    @property
+    def window_size(self) -> int:
+        return self._meta_get("window_size")
+
+    @property
+    def feature_dim(self) -> int:
+        return self._meta_get("feature_dim")
+
+    @property
+    def quantiles(self) -> tuple[float, ...]:
+        return self._meta_get("quantiles")
+
+    @property
+    def delta_mask(self):
+        return self._meta_get("delta_mask")
+
+    @property
+    def space_dict(self):
+        return self._meta_get("space_dict")
+
+    def median_index(self) -> int:
+        return self._meta_get("median_index")
+
+    def space(self):
+        space_dict = self._meta_get("space_dict")
+        if space_dict is None:
+            return None
+        from deeprest_tpu.data.featurize import CallPathSpace
+
+        return CallPathSpace.from_dict(space_dict)
+
+    def admit(self, tenant: str | None):
+        """The PredictionService admission hook (fast 429 on overload)."""
+        return self.admission.try_acquire(tenant)
+
+    def _pick(self):
+        """Least-outstanding-work replica (ties: round-robin), waiting
+        briefly through a rolling reload's drain gap."""
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while True:
+            with self._lock:
+                live = [r for r in self._replicas if r.available()]
+                if live:
+                    self._rr += 1
+                    best = min(
+                        range(len(live)),
+                        key=lambda i: (live[i].outstanding(),
+                                       (i - self._rr) % len(live)))
+                    self._dispatched += 1
+                    return live[best]
+            if time.monotonic() > deadline:
+                raise ServingError(
+                    "no live replica (plane reloading or shut down)",
+                    status=503)
+            time.sleep(0.005)
+
+    def predict_series(self, traffic: np.ndarray,
+                       integrate: bool = True) -> np.ndarray:
+        return self._pick().predict_series(traffic, integrate=integrate)
+
+    def predict_series_many(self, series_list, integrate: bool = True):
+        return self._pick().predict_series_many(series_list,
+                                                integrate=integrate)
+
+    # -- replica plane management ----------------------------------------
+
+    @property
+    def replicas(self) -> list:
+        with self._lock:
+            return list(self._replicas)
+
+    def enable_batching(self, config) -> None:
+        """Per-replica-stack MicroBatchers (one per distinct stack)."""
+        with self._lock:
+            replicas = list(self._replicas)
+            self._batching = config
+        seen = set()
+        for r in replicas:
+            backend = getattr(r, "backend", None)
+            key = id(backend()) if callable(backend) else id(r)
+            if key in seen:
+                continue
+            seen.add(key)
+            r.set_batching(config)
+
+    def rolling_reload_from(self, fresh_backend) -> None:
+        """Zero-downtime reload: drain → swap → re-admit, one stack at a
+        time.  Replicas sharing a stack (same device) drain together and
+        swap once.  Never takes the router lock across a drain wait —
+        requests keep flowing to the other replicas."""
+        with self._lock:
+            replicas = list(self._replicas)
+        groups: dict[int, list] = {}
+        for r in replicas:
+            backend = getattr(r, "backend", None)
+            key = id(backend()) if callable(backend) else id(r)
+            groups.setdefault(key, []).append(r)
+        for group in groups.values():
+            for r in group:
+                r.drain()
+            try:
+                for r in group:
+                    if not r.wait_idle(timeout_s=60.0):
+                        raise ServingError(
+                            f"replica {r.name} failed to drain for reload",
+                            status=503)
+                lead = group[0]
+                fresh = (clone_backend(fresh_backend, device=lead.device)
+                         if callable(getattr(lead, "backend", None))
+                         else fresh_backend)
+                lead.reload_backend(fresh)
+                for r in group[1:]:
+                    r.reload_backend(fresh)
+            finally:
+                for r in group:
+                    r.resume()
+        with self._lock:
+            self._reloads += 1
+            # metadata may legitimately change shape-compatibly (fresh
+            # normalization stats); re-probe from the reloaded lead
+            self._meta = self._probe_meta(replicas[0])
+
+    def scale_to(self, n: int, backend_factory=None) -> int:
+        """Grow/shrink the replica plane to ``n`` (the autoscaler's
+        actuator).  Growth clones from the first live replica's stack (or
+        ``backend_factory()``); shrink drains and closes the tail."""
+        import jax
+
+        if n < 1:
+            raise ValueError(f"replica count {n} must be >= 1")
+        with self._lock:
+            replicas = list(self._replicas)
+        if n == len(replicas):
+            return n
+        if n < len(replicas):
+            with self._lock:
+                keep, drop = self._replicas[:n], self._replicas[n:]
+                self._replicas = keep
+            for r in drop:
+                r.drain()
+            for r in drop:
+                r.wait_idle(timeout_s=30.0)
+                # shared-stack replicas must not close the survivors' stack
+                shared = any(
+                    callable(getattr(k, "backend", None))
+                    and callable(getattr(r, "backend", None))
+                    and k.backend() is r.backend() for k in keep)
+                if not shared:
+                    r.close()
+            return n
+        lead = replicas[0]
+        with self._lock:
+            batching = self._batching
+        if callable(getattr(lead, "backend", None)):       # thread plane
+            devices = list(jax.devices())
+            base = backend_factory() if backend_factory else lead.backend()
+            from deeprest_tpu.serve.batcher import MicroBatcher
+
+            stacks = {}
+            for r in replicas:
+                if callable(getattr(r, "backend", None)) \
+                        and r.device is not None:
+                    stacks[id(r.device)] = r.backend()
+            fresh = []
+            for i in range(len(replicas), n):
+                dev = devices[i % len(devices)]
+                stack = stacks.get(id(dev))
+                if stack is None:
+                    stack = clone_backend(base, device=dev)
+                    if batching is not None and stack.batcher is None:
+                        stack.attach_batcher(
+                            MicroBatcher(stack.ladder, batching))
+                    stacks[id(dev)] = stack
+                fresh.append(EngineReplica(stack, name=f"r{i}", device=dev,
+                                           batching=batching))
+        else:                                              # process plane
+            from deeprest_tpu.serve.replica import ProcessReplica
+
+            fresh = [ProcessReplica(lead.spec, name=f"p{i}")
+                     for i in range(len(replicas), n)]
+        with self._lock:
+            self._replicas.extend(fresh)
+        return n
+
+    def note_autoscaler(self, decision: dict) -> None:
+        """Latest control-loop decision, surfaced on /healthz."""
+        with self._lock:
+            self._autoscaler_decision = dict(decision)
+
+    def close(self) -> None:
+        with self._lock:
+            replicas = list(self._replicas)
+        seen = set()
+        for r in replicas:
+            backend = getattr(r, "backend", None)
+            key = id(backend()) if callable(backend) else id(r)
+            if key in seen:
+                r.drain()
+                continue
+            seen.add(key)
+            r.close()
+
+    # -- observability ---------------------------------------------------
+
+    def router_stats(self) -> dict:
+        with self._lock:
+            replicas = list(self._replicas)
+            reloads = self._reloads
+            dispatched = self._dispatched
+            decision = self._autoscaler_decision
+        return {
+            "replicas": [r.stats() for r in replicas],
+            "num_replicas": len(replicas),
+            "dispatched": dispatched,
+            "rolling_reloads": reloads,
+            "admission": self.admission.stats(),
+            "autoscaler": decision,
+        }
+
+    def jit_cache_size(self) -> int | None:
+        """Total executables across DISTINCT stacks (shared stacks count
+        once — the zero-new-executables-per-replica-beyond-first probe)."""
+        sizes, seen = [], set()
+        for r in self.replicas:
+            backend = getattr(r, "backend", None)
+            if not callable(backend):
+                continue
+            b = backend()
+            if id(b) in seen:
+                continue
+            seen.add(id(b))
+            probe = getattr(b, "jit_cache_size", None)
+            if callable(probe):
+                s = probe()
+                if s is not None:
+                    sizes.append(s)
+        return sum(sizes) if sizes else None

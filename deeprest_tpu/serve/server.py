@@ -24,6 +24,15 @@ backend, so windows from simultaneous /v1/predict, /v1/whatif*, and
 /v1/anomaly calls coalesce into shared shape-laddered device batches and
 demultiplex back per request — the wire protocol is unchanged, and
 ``/healthz`` exposes queue depth and ladder hit statistics.
+
+The backend may also be a multi-replica routing front
+(serve/router.ReplicaRouter) — same serving protocol, plus an admission
+hook the POST handlers call per request: a saturated plane answers a
+fast 429 with a ``Retry-After`` header (AdmissionError), tenants are
+metered by the ``X-Tenant`` request header, and ``/healthz`` grows a
+``router`` key (per-replica outstanding work, admission counters,
+autoscaler decision).  Single-engine backends admit everything — the
+wire behavior is unchanged when no router is configured.
 """
 
 from __future__ import annotations
@@ -40,11 +49,14 @@ from deeprest_tpu.serve.whatif import WhatIfEstimator
 
 
 class ServingError(ValueError):
-    """Client error carrying an HTTP status."""
+    """Client error carrying an HTTP status (and optional extra response
+    headers — e.g. ``Retry-After`` on admission-control 429s)."""
 
-    def __init__(self, message: str, status: int = 400):
+    def __init__(self, message: str, status: int = 400,
+                 headers: dict[str, str] | None = None):
         super().__init__(message)
         self.status = status
+        self.headers = dict(headers) if headers else {}
 
 
 class CheckpointReloader:
@@ -202,9 +214,19 @@ class PredictionService:
 
     def enable_batching(self, config: BatcherConfig) -> None:
         """(Re)build the cross-request MicroBatcher over the current
-        backend's shape ladder and route its traffic through it."""
+        backend's shape ladder and route its traffic through it.
+
+        A multi-replica router backend owns one batcher PER replica, so
+        the config is delegated there and the service-level batcher slot
+        stays empty (``/healthz`` reports per-replica batcher stats under
+        the ``router`` key instead)."""
         with self._lock:
             pred = self.predictor
+        if hasattr(pred, "replicas"):          # ReplicaRouter backend
+            pred.enable_batching(config)
+            with self._lock:
+                self.batching = config
+            return
         fresh = MicroBatcher(pred.ladder, config)
         pred.attach_batcher(fresh)
         with self._lock:
@@ -214,14 +236,22 @@ class PredictionService:
             old.close()               # drain outside the lock
 
     def close(self) -> None:
-        """Release the batcher's worker thread (idempotent)."""
+        """Release the batcher's worker thread (idempotent).  Tolerates
+        minimal test/protocol backends that implement only the read-side
+        serving surface (``predict_series`` + metadata) and carry no
+        batcher attachment point or replica plane."""
         with self._lock:
             old, self.batcher = self.batcher, None
             self.batching = None
             pred = self.predictor
-        pred.attach_batcher(None)
+        detach = getattr(pred, "attach_batcher", None)
+        if callable(detach):
+            detach(None)
         if old is not None:
             old.close()
+        shutdown = getattr(pred, "close", None)   # router: drain replicas
+        if callable(shutdown):
+            shutdown()
 
     def maybe_reload(self) -> None:
         """Swap in a newer backend if the reloader has one (serving a
@@ -230,6 +260,20 @@ class PredictionService:
             return
         fresh = self._reloader.poll()
         if fresh is None:
+            return
+        with self._lock:
+            current = self.predictor
+        if hasattr(current, "rolling_reload_from"):
+            # Multi-replica router: drain and re-image one replica at a
+            # time (zero downtime; no request ever observes mixed old/new
+            # params — each request is served end-to-end by the single
+            # backend its replica held when it was dispatched).
+            fresh_whatif = (WhatIfEstimator(current, self._synthesizer)
+                            if self._synthesizer is not None else None)
+            current.rolling_reload_from(fresh)
+            with self._lock:
+                self.whatif = fresh_whatif
+                self.reloads += 1
             return
         # Build the fresh backend's batcher/estimator BEFORE publishing,
         # so other threads only ever see fully-wired backends; the old
@@ -254,6 +298,23 @@ class PredictionService:
 
     # -- GET ------------------------------------------------------------
 
+    def admission(self, tenant: str | None):
+        """Admission gate for one POST request: the router backend meters
+        in-flight requests globally and per tenant (fast 429 +
+        ``Retry-After`` when the plane is saturated); single-engine
+        backends admit everything.  The HTTP handler enters this BEFORE
+        parsing the request body, so shed load costs the plane a header
+        read, not a JSON parse — overload rejection must stay cheap or
+        the 429 path itself collapses the host."""
+        with self._lock:
+            pred = self.predictor
+        admit = getattr(pred, "admit", None)
+        if callable(admit):
+            return admit(tenant)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def healthz(self) -> dict:
         pred, _, batcher, reloads = self._snapshot()
         out = {
@@ -263,6 +324,12 @@ class PredictionService:
             "window_size": pred.window_size,
             "reloads": reloads,
         }
+        router_stats = getattr(pred, "router_stats", None)
+        if callable(router_stats):
+            # replica plane observability: per-replica outstanding work,
+            # admission counters, per-tenant grants, autoscaler decision
+            # (additive key; existing wire fields untouched)
+            out["router"] = router_stats()
         # Queue depth + shape-ladder hit stats ride on the liveness probe
         # (additive keys: the wire protocol's existing fields are
         # untouched).  Batching disabled still reports the backend's
@@ -435,11 +502,14 @@ class PredictionServer:
             def log_message(self, fmt, *args):   # quiet by default
                 pass
 
-            def _reply(self, status: int, body: dict):
+            def _reply(self, status: int, body: dict,
+                       headers: dict | None = None):
                 blob = json.dumps(body).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(blob)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(blob)
 
@@ -460,12 +530,24 @@ class PredictionServer:
                 try:
                     outer.service.maybe_reload()
                     length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length) or b"{}")
-                    if not isinstance(payload, dict):
-                        raise ServingError("request body must be a JSON object")
-                    self._reply(200, getattr(outer.service, name)(payload))
+                    # the body must be drained either way (keep-alive
+                    # framing), but it stays UNPARSED until admission: a
+                    # shed request costs a read, not a JSON decode
+                    raw = self.rfile.read(length)
+                    # multi-tenant fairness key (weighted round-robin in
+                    # the router's admission gate); absent header = the
+                    # shared default tenant
+                    tenant = self.headers.get("X-Tenant")
+                    with outer.service.admission(tenant):
+                        payload = json.loads(raw or b"{}")
+                        if not isinstance(payload, dict):
+                            raise ServingError(
+                                "request body must be a JSON object")
+                        self._reply(200,
+                                    getattr(outer.service, name)(payload))
                 except ServingError as e:
-                    self._reply(e.status, {"error": str(e)})
+                    self._reply(e.status, {"error": str(e)},
+                                headers=e.headers)
                 except json.JSONDecodeError as e:
                     self._reply(400, {"error": f"bad JSON: {e}"})
                 except Exception as e:  # handler bug: 500, not a dead socket

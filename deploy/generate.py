@@ -287,6 +287,67 @@ def monitoring_stack() -> list[dict]:
     return [sa, role, binding, config, dep, svc]
 
 
+PREDICTOR = "deeprest-predictor"
+PREDICTOR_PORT = 2021
+PREDICTOR_REPLICAS = 2     # the autoscaler rewrites spec.replicas in place
+
+
+def predictor_stack(image: str) -> list[dict]:
+    """The prediction service itself: the multi-replica serving plane
+    (deeprest_tpu serve --replicas) behind one Service, with the
+    autoscaler loop mirroring its decisions into THIS manifest's
+    ``spec.replicas`` (deploy/autoscaler.py).  Each pod runs the router +
+    in-process engine replicas; k8s-level replicas multiply that by
+    process isolation — the two layers compose."""
+    container = {
+        "name": PREDICTOR,
+        "image": image,
+        "command": ["python", "-m", "deeprest_tpu"],
+        "args": ["serve",
+                 "--ckpt-dir=/var/lib/deeprest/ckpt",
+                 "--watch=10",
+                 "--host=0.0.0.0",
+                 f"--port={PREDICTOR_PORT}",
+                 "--replicas=2",
+                 "--admission-depth=256"],
+        "ports": [{"containerPort": PREDICTOR_PORT, "name": "http"}],
+        "readinessProbe": {
+            "httpGet": {"path": "/healthz", "port": PREDICTOR_PORT},
+            "periodSeconds": 5,
+        },
+        "volumeMounts": [{"name": "ckpt",
+                          "mountPath": "/var/lib/deeprest"}],
+        "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+    }
+    dep = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta(PREDICTOR),
+        "spec": {
+            "replicas": PREDICTOR_REPLICAS,
+            "selector": {"matchLabels": {"app": PREDICTOR}},
+            "template": {
+                "metadata": {"labels": {"app": PREDICTOR,
+                                        "plane": "deeprest-sns"}},
+                "spec": {
+                    "containers": [container],
+                    "volumes": [{"name": "ckpt",
+                                 "persistentVolumeClaim":
+                                     {"claimName": f"{PREDICTOR}-pvc"}}],
+                    "restartPolicy": "Always",
+                },
+            },
+        },
+    }
+    svc = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta(PREDICTOR),
+        "spec": {"selector": {"app": PREDICTOR},
+                 "ports": [{"name": "http", "port": PREDICTOR_PORT,
+                            "targetPort": PREDICTOR_PORT}]},
+    }
+    return [svc, dep, pvc(PREDICTOR)]
+
+
 def loadgen_job(image: str) -> dict:
     """Drives the DEPLOYED plane through its gateway services (the locust
     role, reference: locust/README.md:23-33); the deployed collector owns
@@ -340,6 +401,7 @@ def generate(image: str) -> dict[str, list[dict]]:
     ]
     files["loadgen-job.yaml"] = [loadgen_job(image)]
     files["monitoring.yaml"] = monitoring_stack()
+    files["predictor.yaml"] = predictor_stack(image)
     return files
 
 

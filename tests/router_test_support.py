@@ -1,0 +1,32 @@
+"""Importable-by-name factory for ProcessReplica tests: the spawned
+worker builds its own tiny Predictor stack from this module (the spec's
+``sys_path`` carries the tests directory into the child)."""
+
+import numpy as np
+
+F, E, H, W = 6, 3, 8, 8
+
+
+def build_tiny(scale: float = 1.0, ladder=(8,)):
+    import jax
+
+    from deeprest_tpu.config import ModelConfig
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.models.qrnn import QuantileGRU
+    from deeprest_tpu.serve import Predictor
+
+    mc = ModelConfig(feature_dim=F, num_metrics=E, hidden_size=H,
+                     dropout_rate=0.0)
+    model = QuantileGRU(config=mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, W, F), np.float32),
+                        deterministic=True)["params"]
+    if scale != 1.0:
+        params = jax.tree.map(lambda a: a * scale, params)
+    return Predictor(
+        params, mc,
+        x_stats=MinMaxStats(min=np.float32(0.0), max=np.float32(1.0)),
+        y_stats=MinMaxStats(min=np.zeros((E,), np.float32),
+                            max=np.ones((E,), np.float32)),
+        metric_names=[f"c{i}_cpu" for i in range(E)],
+        window_size=W, ladder=tuple(ladder))

@@ -537,6 +537,194 @@ class Service:
 
 
 # ---------------------------------------------------------------------------
+# TH003: state mutated across a multiprocessing boundary
+
+
+TH003_BAD = """
+import multiprocessing as mp
+
+class Replica:
+    def __init__(self):
+        self.served = 0
+        self._proc = mp.Process(target=self._worker)
+        self._proc.start()
+
+    def _worker(self):
+        self.served += 1          # mutates the CHILD's copy only
+
+    def outstanding(self):
+        return self.served        # parent reads frozen state forever
+"""
+
+TH003_GOOD = """
+import multiprocessing as mp
+
+class Replica:
+    def __init__(self):
+        ctx = mp.get_context("spawn")
+        self._conn, child = ctx.Pipe(duplex=True)
+        self._proc = ctx.Process(target=_worker_main, args=(child,))
+        self._proc.start()
+
+    def outstanding(self):
+        self._conn.send("stats")
+        return self._conn.recv()
+
+def _worker_main(conn):
+    served = 0
+    while True:
+        msg = conn.recv()
+        served += 1
+        conn.send(served)
+"""
+
+
+def test_th003_pair():
+    assert_pair("TH003", TH003_BAD, TH003_GOOD)
+
+
+def test_th003_transitive_child_side_write():
+    bad = """
+import multiprocessing
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        multiprocessing.Process(target=self._run).start()
+
+    def _run(self):
+        self._bump()
+
+    def _bump(self):
+        self.count += 1
+
+    def report(self):
+        return self.count
+"""
+    fired = findings_for("TH003", bad)
+    assert fired and "child" in fired[0].message
+
+
+def test_th003_child_only_state_is_silent():
+    # the child may freely mutate state nothing parent-side reads
+    src = """
+import multiprocessing
+
+class Worker:
+    def __init__(self):
+        multiprocessing.Process(target=self._run).start()
+
+    def _run(self):
+        self.local_count = 0
+        self.local_count += 1
+"""
+    assert not findings_for("TH003", src)
+
+
+# ---------------------------------------------------------------------------
+# TH004: inconsistent lock discipline
+
+
+TH004_BAD = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = []
+
+    def add(self, r):
+        with self._lock:
+            self._replicas = self._replicas + [r]
+
+    def pick(self):
+        return self._replicas[0]      # unguarded read of guarded state
+"""
+
+TH004_GOOD = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = []
+
+    def add(self, r):
+        with self._lock:
+            self._replicas = self._replicas + [r]
+
+    def pick(self):
+        with self._lock:
+            return self._replicas[0]
+"""
+
+
+def test_th004_pair():
+    assert_pair("TH004", TH004_BAD, TH004_GOOD)
+
+
+def test_th004_unguarded_write_fires():
+    bad = """
+import threading
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._served = 0
+
+    def snapshot(self):
+        with self._lock:
+            return self._served
+
+    def bump(self):
+        self._served += 1             # write outside the lock
+"""
+    fired = findings_for("TH004", bad)
+    assert fired and "without the class lock" in fired[0].message
+
+
+def test_th004_locked_suffix_convention_is_silent():
+    # *_locked helpers run with the lock already held by their caller
+    src = """
+import threading
+
+class Admission:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def release(self):
+        with self._lock:
+            self._inflight -= 1
+            self._grant_next_locked()
+
+    def _grant_next_locked(self):
+        self._inflight += 1
+"""
+    assert not findings_for("TH004", src)
+
+
+def test_th004_consistently_unlocked_class_is_silent():
+    # no lock discipline declared for the attribute: TH004 has no
+    # inconsistency to flag (TH001 owns the thread-entry race proof)
+    src = """
+import threading
+
+class Plain:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mode = "idle"
+
+    def set_mode(self, m):
+        self.mode = m
+
+    def get_mode(self):
+        return self.mode
+"""
+    assert not findings_for("TH004", src)
+
+
+# ---------------------------------------------------------------------------
 # HY rules
 
 
@@ -692,7 +880,7 @@ def test_cli_list_rules(capsys):
     assert main(["lint", "--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("JX001", "JX002", "JX003", "JX004", "TH001", "TH002",
-                "HY001", "HY002", "GL001"):
+                "TH003", "TH004", "HY001", "HY002", "GL001"):
         assert rid in out
     assert "PR 4" in out        # rules cite the incidents they guard
 
@@ -700,6 +888,7 @@ def test_cli_list_rules(capsys):
 def test_rule_registry_complete():
     rules = all_rules()
     assert {"JX001", "JX002", "JX003", "JX004",
-            "TH001", "TH002", "HY001", "HY002"} <= set(rules)
+            "TH001", "TH002", "TH003", "TH004",
+            "HY001", "HY002"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards

@@ -1,0 +1,67 @@
+"""serve_bench --quick stays runnable as a tier-1 gate: the closed-loop
+HTTP bench (single-engine modes + the replica-router sweep + admission)
+must complete, emit the schema-v2 document, and hold the zero-new-
+compiles-post-warmup discipline on every plane."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_serve_bench_quick_end_to_end(tmp_path):
+    out = tmp_path / "serve_bench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "serve_bench.py"),
+         "--quick", f"--out={out}"],
+        capture_output=True, text=True, cwd=REPO, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        doc = json.load(f)
+
+    assert doc["schema_version"] == 2
+    assert doc["metric"] == "serve_predict_rps"
+
+    # v1 cells intact: both single-engine modes measured something
+    modes = {r["mode"] for r in doc["results"]}
+    assert modes == {"batched", "per_request"}
+    assert all(r["requests"] > 0 and r["errors"] == 0
+               for r in doc["results"])
+
+    # v2 cells: the replica sweep ran every (replicas, concurrency) cell
+    assert doc["replica_results"], "replica sweep produced no cells"
+    replica_counts = {r["replicas"] for r in doc["replica_results"]}
+    assert replica_counts == {1, 2}
+    for cell in doc["replica_results"]:
+        assert cell["mode"] == "replicated"
+        assert cell["requests"] > 0 and cell["errors"] == 0
+        assert len(cell["per_replica_served"]) == cell["replicas"]
+        assert cell["admission"]["depth"] >= 1
+        # goodput + shed load must cover every admitted request
+        assert cell["admission"]["admitted"] >= cell["requests"]
+        # the in-plane latency window (what the admission bound controls)
+        # is measured per cell
+        assert cell["in_plane_p99_ms"] is not None
+        assert cell["in_plane_p99_ms"] > 0
+
+    # replica cells at N=2 really split work across both replicas
+    two = [c for c in doc["replica_results"] if c["replicas"] == 2]
+    assert any(min(c["per_replica_served"]) > 0 for c in two)
+
+    # headline + sweep summaries present and coherent
+    assert doc["headline"] is not None
+    assert doc["replica_sweep"]["rps_by_replicas"]["1"] > 0
+    assert "speedup_2_vs_1" in doc["replica_sweep"]
+    assert doc["admission_at_max"] is not None
+
+    # the acceptance discipline: zero post-warmup compiles on BOTH planes
+    assert doc["new_compiles_after_warmup"] == 0
+    assert doc["replica_new_compiles_after_warmup"] == 0
+
+    # the honest-CPU footnote travels with every CPU-tier document
+    if doc["platform"] == "cpu":
+        assert doc["honest_cpu"] is not None
+        assert "contention" in doc["honest_cpu"]["note"]
