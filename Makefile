@@ -16,6 +16,10 @@
 #                         admission, concurrency up to 1024) — refreshes
 #                         benchmarks/serve_bench.json; the hardware
 #                         scaling curve rides benchmarks/tpu_queue.sh
+#   make obs-bench        the observability overhead gate (serve + train
+#                         hot paths, obs off/on A/B, asserted <=3%
+#                         budget) — refreshes benchmarks/obs_bench.json;
+#                         the on-chip number rides benchmarks/tpu_queue.sh
 
 PYTHON ?= python
 
@@ -34,4 +38,7 @@ bench-multichip:
 serve-bench-replicas:
 	$(PYTHON) benchmarks/serve_bench.py --out benchmarks/serve_bench.json
 
-.PHONY: lint native tsan bench-multichip serve-bench-replicas
+obs-bench:
+	$(PYTHON) benchmarks/obs_bench.py --out benchmarks/obs_bench.json
+
+.PHONY: lint native tsan bench-multichip serve-bench-replicas obs-bench

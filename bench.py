@@ -669,8 +669,39 @@ def main() -> None:
     except Exception as exc:
         print(f"bench: infer measurement failed: {exc}", file=sys.stderr)
 
+    # Observability overhead headline (schema v8, NEW key): serve + train
+    # hot paths with obs off/on (benchmarks/obs_bench.py has the full
+    # A/B record + the asserted <=3% budget).  Runs in a child process on
+    # the CPU backend — the parent's never-init-a-backend contract holds.
+    obs_overhead = None
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "obs_bench.py"),
+             "--quick", "--headline"],
+            capture_output=True, text=True, timeout=900, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obs_overhead = float(json.loads(line)["obs_overhead_pct"])
+                break
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                continue
+        if obs_overhead is None:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+            print(f"bench: obs headline produced no record: "
+                  f"{' | '.join(tail)}", file=sys.stderr)
+    except Exception as exc:
+        print(f"bench: obs measurement failed: {exc}", file=sys.stderr)
+
     perf = _mfu_block(measured, F)
     result = {
+        # v8: obs_overhead_pct is the observability-enabled overhead on
+        # the serve+train hot paths (deeprest_tpu/obs; the committed
+        # benchmarks/obs_bench.json asserts the 3% budget in full mode)
+        # — a NEW key, nothing repurposed; every v7 key keeps its
+        # meaning.
         # v7: the measured multi-chip tier (bench.py --mesh /
         # benchmarks/multichip_sweep.py, dossier MULTICHIP_r06.json) adds
         # mesh_shape, multichip_steps_per_sec, scaling_efficiency, and
@@ -697,7 +728,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 7,
+        "schema_version": 8,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -736,6 +767,8 @@ def main() -> None:
         result["etl_buckets_per_sec"] = round(float(etl_bps), 2)
     if rolled_wps is not None:
         result["rolled_windows_per_sec"] = round(rolled_wps, 1)
+    if obs_overhead is not None:
+        result["obs_overhead_pct"] = round(obs_overhead, 3)
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

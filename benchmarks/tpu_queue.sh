@@ -90,6 +90,13 @@ step serve_bench_replicas 2400 env JAX_PLATFORMS=tpu python \
   benchmarks/serve_bench.py --replicas 1,2,4 \
   --replica-concurrency 16,64,256,1024 \
   --out benchmarks/serve_bench_tpu.json
+# Observability overhead on-chip (round 14): the committed CPU
+# obs_bench.json proves the <=3% budget where spans are a visible
+# fraction of a millisecond-scale call; on the accelerator, per-call
+# device work is larger and the span cost should vanish — bank the
+# number so the budget claim covers the production backend too.
+step obs_overhead 900 env JAX_PLATFORMS=tpu python \
+  benchmarks/obs_bench.py --out benchmarks/obs_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -204,7 +204,8 @@ def all_rules() -> dict[str, Rule]:
     """The registry, with every built-in rule pack imported."""
     import importlib
 
-    for pack in ("rules_jax", "rules_threading", "rules_hygiene"):
+    for pack in ("rules_jax", "rules_threading", "rules_hygiene",
+                 "rules_obs"):
         importlib.import_module(f"deeprest_tpu.analysis.{pack}")
     return dict(_REGISTRY)
 

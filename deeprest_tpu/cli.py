@@ -638,6 +638,16 @@ def cmd_serve(args) -> int:
                  "immutable; re-export and restart instead)")
     if args.watch < 0:
         sys.exit(f"error: --watch {args.watch} must be >= 0")
+    # Observability (deeprest_tpu/obs): span recording is ON by default
+    # for the serving plane (it is the subsystem's reason to exist here);
+    # /metrics answers either way — metrics counters are always live.
+    from deeprest_tpu import obs
+
+    if args.obs_span_capacity < 1:
+        sys.exit(f"error: --obs-span-capacity {args.obs_span_capacity} "
+                 "must be >= 1")
+    obs.configure(enabled=not args.no_obs,
+                  span_capacity=args.obs_span_capacity)
     mesh_cfg = _parse_mesh(args)
     if mesh_cfg is not None and args.artifact:
         sys.exit("error: --mesh requires --ckpt-dir (exported artifacts "
@@ -744,6 +754,9 @@ def cmd_serve(args) -> int:
                       "whatif": synthesizer is not None,
                       "replicas": args.replicas,
                       "autoscale": autoscaler is not None,
+                      "obs": {"spans": not args.no_obs,
+                              "span_capacity": args.obs_span_capacity,
+                              "metrics": "/metrics"},
                       "batching": (None if args.no_batcher else {
                           "max_batch": args.batch_max_windows,
                           "max_linger_ms": args.batch_linger_ms,
@@ -865,6 +878,37 @@ def cmd_anomaly(args) -> int:
     flagged = [r.metric for r in reports if r.flagged]
     print(json.dumps({"flagged": flagged}))
     return 1 if flagged and args.fail_on_anomaly else 0
+
+
+def cmd_profile(args) -> int:
+    """Open a jax.profiler capture window on a RUNNING serving plane
+    (POST /v1/profile — obs/profiler.py): the server keeps answering
+    traffic on its other handler threads while the window is open, so
+    the trace shows the plane under its live load.  Inspect the written
+    directory with TensorBoard/XProf."""
+    import urllib.error
+    import urllib.request
+
+    if args.seconds <= 0:
+        sys.exit(f"error: --seconds {args.seconds} must be > 0")
+    payload = {"seconds": args.seconds}
+    if args.out_dir:
+        payload["out_dir"] = args.out_dir
+    req = urllib.request.Request(
+        args.url.rstrip("/") + "/v1/profile",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req,
+                                    timeout=args.seconds + 60.0) as resp:
+            body = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode(errors="replace")[:300]
+        sys.exit(f"error: server answered {exc.code}: {detail}")
+    except (urllib.error.URLError, OSError) as exc:
+        sys.exit(f"error: cannot reach {args.url}: {exc}")
+    print(json.dumps(body))
+    return 0
 
 
 def cmd_lint(args) -> int:
@@ -1240,9 +1284,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mirror decisions into this k8s manifest's "
                         "deeprest-predictor Deployment spec.replicas "
                         "(deploy/k8s/predictor.yaml)")
+    p.add_argument("--no-obs", action="store_true",
+                   help="disable span recording (deeprest_tpu/obs); "
+                        "/metrics and its counters stay live — only the "
+                        "trace ring is gated (near-zero cost either way)")
+    p.add_argument("--obs-span-capacity", type=int, default=4096,
+                   metavar="N",
+                   help="bound on retained spans (newest win; GET "
+                        "/v1/spans exports them as Jaeger JSON for the "
+                        "self-ingestion loop)")
     _add_fused_infer_args(p)
     _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("profile",
+                       help="open a jax.profiler capture window on a "
+                            "running serving plane (POST /v1/profile); "
+                            "inspect with TensorBoard/XProf")
+    p.add_argument("--url", default="http://127.0.0.1:2021",
+                   help="base URL of the running `deeprest serve` plane")
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="capture window length (server bounds it)")
+    p.add_argument("--out-dir", default=None,
+                   help="trace directory on the SERVER host (default: a "
+                        "server-side temp dir, echoed back)")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("lint",
                        help="graftlint: JAX- and concurrency-aware static "

@@ -252,6 +252,26 @@ class InferConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (deeprest_tpu/obs).
+
+    ``enabled`` gates the SPAN recorder only — metrics counters are
+    always live (they are the cheap half, and /metrics must answer even
+    on a spans-off plane).  ``span_capacity`` bounds the in-process span
+    ring (newest win; a long-lived server must never grow unbounded).
+    """
+
+    enabled: bool = False
+    span_capacity: int = 4096
+
+    def __post_init__(self):
+        if self.span_capacity < 1:
+            raise ValueError(
+                f"ObsConfig.span_capacity={self.span_capacity}: must be "
+                ">= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device-mesh shape for pjit/GSPMD execution.
 
@@ -292,6 +312,7 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     etl: EtlConfig = dataclasses.field(default_factory=EtlConfig)
     infer: InferConfig = dataclasses.field(default_factory=InferConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
@@ -322,6 +343,7 @@ class Config:
             mesh=build(MeshConfig, d.get("mesh", {})),
             etl=build(EtlConfig, d.get("etl", {})),
             infer=build(InferConfig, d.get("infer", {})),
+            obs=build(ObsConfig, d.get("obs", {})),
         )
 
     @classmethod

@@ -1,0 +1,56 @@
+"""deeprest_tpu/obs — spans, metrics, and profiling for the whole plane.
+
+One package, four surfaces (ISSUE 9):
+
+- :mod:`.spans` — ring-buffer span recorder with request-scoped trace ids
+  propagated router → admission → replica → batcher → fused dispatch
+  (process replicas forward span batches over their duplex pipe);
+  near-zero cost when disabled.
+- :mod:`.metrics` — counters/gauges/histograms registry rendered as
+  Prometheus text at ``GET /metrics`` on the serving plane; the trainer /
+  stream side emits step time, superstep dispatch counts, compile-cache
+  sizes, ETL stall/lag, and readback counts into the same registry.
+- :mod:`.profiler` — on-demand ``jax.profiler`` capture windows
+  (``POST /v1/profile`` + ``deeprest profile``) and the honest-sync
+  step-time breakdown (host feed vs dispatch vs device wait).
+- :mod:`.export` — spans as Jaeger-style JSON + span-derived busy-seconds
+  as Prometheus range JSON, both consumed by the STANDARD ingest pipeline
+  (data/ingest.py), so the plane's own traffic becomes a DeepRest corpus
+  and the estimator can estimate itself.
+
+Nothing here imports jax at module scope (the profiler imports it inside
+its functions) — obs is safe to wire through every layer, including the
+CLI's lazy-import cold path.
+"""
+
+from __future__ import annotations
+
+from deeprest_tpu.obs.metrics import (
+    PROMETHEUS_CONTENT_TYPE, REGISTRY, Counter, Gauge, Histogram,
+    MetricsRegistry, Stopwatch,
+)
+from deeprest_tpu.obs.spans import (
+    NULL_SPAN, RECORDER, SpanRecord, SpanRecorder, current_context,
+    set_context, span,
+)
+
+
+def configure(enabled: bool | None = None,
+              span_capacity: int | None = None) -> None:
+    """Flip the process-default span recorder (the serve CLI's ``--obs``
+    knob).  Metrics counters are always live — they are the cheap half —
+    so only span recording is gated.  The recorder is reconfigured IN
+    PLACE: every module already holding the reference keeps recording
+    into the same object."""
+    if span_capacity is not None and span_capacity != RECORDER.capacity:
+        RECORDER.set_capacity(span_capacity)
+    if enabled is not None:
+        RECORDER.enabled = bool(enabled)
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Stopwatch",
+    "REGISTRY", "PROMETHEUS_CONTENT_TYPE",
+    "SpanRecord", "SpanRecorder", "RECORDER", "NULL_SPAN",
+    "span", "current_context", "set_context", "configure",
+]

@@ -43,6 +43,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
+
 DEFAULT_LADDER = (8, 16, 32, 64)
 
 
@@ -171,11 +174,15 @@ class ShapeLadder:
 
 
 class _Pending:
-    __slots__ = ("x", "future")
+    __slots__ = ("x", "future", "ctx")
 
     def __init__(self, x: np.ndarray):
         self.x = x
         self.future: Future = Future()
+        # The submitting request's trace context: the worker thread's
+        # coalesced-dispatch span parents onto the first submitter so a
+        # request's trace reaches across the thread boundary.
+        self.ctx = obs_spans.current_context()
 
 
 def _inflight_ready(inflight) -> bool:
@@ -202,10 +209,17 @@ class MicroBatcher:
         self._pending: collections.deque[_Pending] = collections.deque()
         self._pending_windows = 0
         self._running = True
-        self._stats = {"submitted": 0, "batches": 0, "windows": 0,
-                       "max_batch_windows": 0, "coalesced_batches": 0,
-                       "flush_full": 0, "flush_linger": 0,
-                       "flush_pipeline": 0, "errors": 0}
+        # Batch accounting lives in obs metrics (per-instance objects —
+        # the /healthz JSON view and the /metrics exposition read the
+        # SAME counters; the newest plane's batcher owns the exposition
+        # binding via the serving collector).
+        self._m = obs_metrics.Counter(
+            "deeprest_batcher_events_total",
+            "micro-batcher accounting by event kind",
+            labelnames=("event",))
+        self._m_max_batch = obs_metrics.Gauge(
+            "deeprest_batcher_max_batch_windows",
+            "widest coalesced batch dispatched (high-water mark)")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="microbatcher")
         self._thread.start()
@@ -230,7 +244,7 @@ class MicroBatcher:
                 raise BatcherClosed("micro-batcher is closed")
             self._pending.append(p)
             self._pending_windows += len(x)
-            self._stats["submitted"] += 1
+            self._m.inc(event="submitted")
             self._cv.notify_all()
         return p.future
 
@@ -239,8 +253,22 @@ class MicroBatcher:
         return self.submit(x).result()
 
     def stats(self) -> dict:
+        # Same JSON shape as the historical dict — now a VIEW over the
+        # obs counters (one source of truth with /metrics).
+        events = self._m.series()
+
+        def ev(name: str) -> int:
+            return int(events.get((name,), 0.0))
+
+        out = {"submitted": ev("submitted"), "batches": ev("batches"),
+               "windows": ev("windows"),
+               "max_batch_windows": int(self._m_max_batch.value()),
+               "coalesced_batches": ev("coalesced_batches"),
+               "flush_full": ev("flush_full"),
+               "flush_linger": ev("flush_linger"),
+               "flush_pipeline": ev("flush_pipeline"),
+               "errors": ev("errors")}
         with self._cv:
-            out = dict(self._stats)
             out["queue_depth_windows"] = self._pending_windows
             out["queue_depth_requests"] = len(self._pending)
         out["max_batch"] = self.config.max_batch
@@ -299,25 +327,33 @@ class MicroBatcher:
                 reason = ("flush_pipeline" if not block
                           else "flush_full" if take >= cfg.max_batch
                           else "flush_linger")
-                self._stats[reason] += 1
-                self._stats["batches"] += 1
-                self._stats["windows"] += take
-                self._stats["coalesced_batches"] += len(group) > 1
-                self._stats["max_batch_windows"] = max(
-                    self._stats["max_batch_windows"], take)
+                self._m.inc(event=reason)
+                self._m.inc(event="batches")
+                self._m.inc(take, event="windows")
+                if len(group) > 1:
+                    self._m.inc(event="coalesced_batches")
+                self._m_max_batch.set_max(take)
                 self._cv.notify_all()      # wake back-pressured submitters
             return group
 
     def _dispatch(self, group: list[_Pending]):
-        """Concatenate + stage + async-dispatch one coalesced batch."""
+        """Concatenate + stage + async-dispatch one coalesced batch.
+
+        The dispatch span parents onto the FIRST submitter's captured
+        trace context (request-scoped ids cross the worker-thread
+        boundary) and tags how many requests coalesced.
+        """
         sizes = [len(p.x) for p in group]
         try:
-            x = (group[0].x if len(group) == 1
-                 else np.concatenate([p.x for p in group], axis=0))
-            parts = self._ladder.dispatch(x)
+            with obs_spans.RECORDER.span(
+                    "batch.dispatch", component="deeprest-batcher",
+                    parent=group[0].ctx) as sp:
+                sp.tag(requests=len(group), windows=sum(sizes))
+                x = (group[0].x if len(group) == 1
+                     else np.concatenate([p.x for p in group], axis=0))
+                parts = self._ladder.dispatch(x)
         except Exception as exc:
-            with self._cv:
-                self._stats["errors"] += 1
+            self._m.inc(event="errors")
             for p in group:
                 p.future.set_exception(exc)
             return None
@@ -328,8 +364,7 @@ class MicroBatcher:
         try:
             y = ShapeLadder.materialize(parts)
         except Exception as exc:
-            with self._cv:
-                self._stats["errors"] += 1
+            self._m.inc(event="errors")
             for p in group:
                 p.future.set_exception(exc)
             return

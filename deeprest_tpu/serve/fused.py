@@ -50,6 +50,8 @@ import threading
 
 import numpy as np
 
+from deeprest_tpu.obs import spans as obs_spans
+
 DEFAULT_FUSED_RUNGS = (8, 16, 32, 64)
 
 
@@ -271,6 +273,17 @@ class FusedRolledEngine:
             return []
         feat = arrays[0].shape[1]
         metas = plan_windows([len(a) for a in arrays], w)
+        # One span for the whole fused train of dispatches (per-page
+        # spans would put recorder traffic inside the hot paging loop);
+        # inherits the request's trace context from the calling thread.
+        with obs_spans.RECORDER.span("fused.predict",
+                                     component="deeprest-engine") as sp:
+            sp.tag(series=len(arrays), windows=len(metas))
+            return self._predict_many_inner(arrays, metas, feat, integrate,
+                                            jnp)
+
+    def _predict_many_inner(self, arrays, metas, feat, integrate, jnp):
+        w = self.window_size
         # Coalesced dispatch stride: up to coalesce_pages pages per batch
         # (the super-rungs are in self.rungs, so rung_for always fits).
         page = self.page * self.coalesce_pages

@@ -227,12 +227,28 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         spec list to drift from training's.  The checkpoint may have been
         saved under any mesh shape (restore assembles by global index).
         """
+        from deeprest_tpu.obs import spans as obs_spans
         from deeprest_tpu.parallel.mesh import make_mesh
         from deeprest_tpu.train.checkpoint import (
             latest_step, load_sidecar, restore_checkpoint,
         )
         from deeprest_tpu.train.trainer import Trainer
 
+        with obs_spans.RECORDER.span("predictor.load",
+                                     component="deeprest-predictor") as sp:
+            sp.tag(directory=directory, step=step)
+            return cls._from_checkpoint_inner(
+                directory, config, step, ladder, fused, page_windows,
+                coalesce_pages, coalesce_groups, mesh_config,
+                make_mesh, latest_step, load_sidecar, restore_checkpoint,
+                Trainer)
+
+    @classmethod
+    def _from_checkpoint_inner(cls, directory, config, step, ladder, fused,
+                               page_windows, coalesce_pages,
+                               coalesce_groups, mesh_config, make_mesh,
+                               latest_step, load_sidecar,
+                               restore_checkpoint, Trainer) -> "Predictor":
         if step is None:
             step = latest_step(directory)
             if step is None:

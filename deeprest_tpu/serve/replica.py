@@ -41,6 +41,9 @@ import threading
 
 import numpy as np
 
+from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
+
 
 def _num_windows(t: int, w: int) -> int:
     """Window count of a [T, F] series under the serving tiling (regular
@@ -134,8 +137,15 @@ class EngineReplica:
         self._cv = threading.Condition(self._lock)
         self._backend = backend
         self._outstanding = 0          # windows currently dispatched here
-        self._served_requests = 0
-        self._served_windows = 0
+        # Served totals are obs Counters (per-instance objects): the
+        # stats() JSON, the router's /metrics collector, and the
+        # autoscaler's demand read all consume the SAME objects.
+        self._m_served_requests = obs_metrics.Counter(
+            "deeprest_replica_served_requests_total",
+            labelnames=("replica",))
+        self._m_served_windows = obs_metrics.Counter(
+            "deeprest_replica_served_windows_total",
+            labelnames=("replica",))
         self._draining = False
         self._closed = False
         self._batching = batching
@@ -173,9 +183,15 @@ class EngineReplica:
     def _end(self, windows: int, requests: int = 1) -> None:
         with self._cv:
             self._outstanding -= windows
-            self._served_requests += requests
-            self._served_windows += windows
             self._cv.notify_all()      # wake wait_idle() drains
+        self._m_served_requests.inc(requests, replica=self.name)
+        self._m_served_windows.inc(windows, replica=self.name)
+
+    def served_requests(self) -> int:
+        return int(self._m_served_requests.value(replica=self.name))
+
+    def served_windows(self) -> int:
+        return int(self._m_served_windows.value(replica=self.name))
 
     def predict_series(self, traffic: np.ndarray,
                        integrate: bool = True) -> np.ndarray:
@@ -183,7 +199,11 @@ class EngineReplica:
             backend = self._backend
         n = self._begin(_num_windows(len(traffic), backend.window_size))
         try:
-            with _device_ctx(self.device):
+            with _device_ctx(self.device), \
+                    obs_spans.RECORDER.span(
+                        "replica.predict",
+                        component="deeprest-replica") as sp:
+                sp.tag(replica=self.name, windows=n)
                 return backend.predict_series(traffic, integrate=integrate)
         finally:
             self._end(n)
@@ -195,7 +215,12 @@ class EngineReplica:
         n = self._begin(sum(_num_windows(len(s), backend.window_size)
                             for s in series_list))
         try:
-            with _device_ctx(self.device):
+            with _device_ctx(self.device), \
+                    obs_spans.RECORDER.span(
+                        "replica.predict",
+                        component="deeprest-replica") as sp:
+                sp.tag(replica=self.name, windows=n,
+                       series=len(series_list))
                 return backend.predict_series_many(series_list,
                                                    integrate=integrate)
         finally:
@@ -276,8 +301,8 @@ class EngineReplica:
                 "kind": self.kind,
                 "device": str(self.device) if self.device is not None else None,
                 "outstanding_windows": self._outstanding,
-                "served_requests": self._served_requests,
-                "served_windows": self._served_windows,
+                "served_requests": self.served_requests(),
+                "served_windows": self.served_windows(),
                 "state": ("closed" if self._closed
                           else "draining" if self._draining else "live"),
             }
@@ -351,11 +376,24 @@ def build_backend_from_spec(spec: dict):
 
 def _worker_main(spec: dict, conn) -> None:
     """Subprocess entry: build the stack, then serve pipe requests on a
-    small thread pool (so the in-child MicroBatcher still coalesces)."""
+    small thread pool (so the in-child MicroBatcher still coalesces).
+
+    Observability: with ``spec["obs"]`` the child enables its own span
+    recorder, adopts the parent's propagated ``(trace_id, span_id)``
+    context per request, and forwards its committed spans back over the
+    SAME duplex pipe as ``"__spans__"``-tagged messages — the parent's
+    reader ingests them into the process-default recorder, so a request's
+    trace crosses the process boundary intact.
+    """
     import os
     from concurrent.futures import ThreadPoolExecutor
 
     os.environ.setdefault("JAX_PLATFORMS", spec.get("jax_platform", "cpu"))
+    obs_on = bool(spec.get("obs"))
+    if obs_on:
+        from deeprest_tpu import obs
+
+        obs.configure(enabled=True)
     try:
         backend = build_backend_from_spec(spec)
         if spec.get("batching"):
@@ -378,22 +416,37 @@ def _worker_main(spec: dict, conn) -> None:
     }))
     send_lock = threading.Lock()
 
-    def handle(req_id, method, args):
+    def handle(req_id, method, args, ctx=None):
+        token = obs_spans.set_context(ctx) if ctx is not None else None
         try:
-            if method == "predict_series":
-                traffic, integrate = args
-                out = backend.predict_series(traffic, integrate=integrate)
-            elif method == "predict_series_many":
-                series_list, integrate = args
-                out = backend.predict_series_many(series_list,
-                                                  integrate=integrate)
-            else:
-                raise ValueError(f"unknown method {method!r}")
+            with obs_spans.RECORDER.span("replica.worker",
+                                         component="deeprest-replica") as sp:
+                if method == "predict_series":
+                    traffic, integrate = args
+                    sp.tag(method=method, windows=_num_windows(
+                        len(traffic), backend.window_size))
+                    out = backend.predict_series(traffic,
+                                                 integrate=integrate)
+                elif method == "predict_series_many":
+                    series_list, integrate = args
+                    sp.tag(method=method, series=len(series_list))
+                    out = backend.predict_series_many(series_list,
+                                                      integrate=integrate)
+                else:
+                    raise ValueError(f"unknown method {method!r}")
             with send_lock:
                 conn.send((req_id, True, out))
         except Exception as exc:
             with send_lock:
                 conn.send((req_id, False, f"{type(exc).__name__}: {exc}"))
+        finally:
+            if token is not None:
+                obs_spans.set_context(None)
+            if obs_on:
+                batch = [r.to_dict() for r in obs_spans.RECORDER.drain()]
+                if batch:
+                    with send_lock:
+                        conn.send(("__spans__", True, batch))
 
     with ThreadPoolExecutor(max_workers=int(spec.get("worker_threads", 4))) \
             as pool:
@@ -420,12 +473,19 @@ class ProcessReplica:
         self.name = name
         self.device = None             # the child owns its device binding
         self.spec = dict(spec)
+        # The child mirrors the parent's span-recording state at boot
+        # (an explicit spec["obs"] wins — tests pin both modes).
+        self.spec.setdefault("obs", obs_spans.RECORDER.enabled)
         self.boot_timeout_s = boot_timeout_s
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._outstanding = 0
-        self._served_requests = 0
-        self._served_windows = 0
+        self._m_served_requests = obs_metrics.Counter(
+            "deeprest_replica_served_requests_total",
+            labelnames=("replica",))
+        self._m_served_windows = obs_metrics.Counter(
+            "deeprest_replica_served_windows_total",
+            labelnames=("replica",))
         self._draining = False
         self._closed = False
         self._next_id = 0
@@ -491,7 +551,9 @@ class ProcessReplica:
 
     def _read_loop(self, conn) -> None:
         """Resolve response futures from ONE pipe generation; a reload
-        swaps the pipe, and this loop exits on its EOF."""
+        swaps the pipe, and this loop exits on its EOF.  ``"__spans__"``
+        messages are the worker's forwarded span batches — ingested into
+        the parent's recorder, never a request response."""
         while True:
             try:
                 req_id, ok, payload = conn.recv()
@@ -506,6 +568,10 @@ class ProcessReplica:
                     f.set_exception(RuntimeError(
                         f"replica {self.name}: worker exited"))
                 return
+            if req_id == "__spans__":
+                if ok:
+                    obs_spans.RECORDER.ingest(payload)
+                continue
             with self._lock:
                 fut = self._futures.pop(req_id, None)
             if fut is None:
@@ -528,16 +594,25 @@ class ProcessReplica:
             self._outstanding += windows
             conn = self._conn
         try:
+            # the propagated trace context rides in the request tuple, so
+            # the child's spans join this request's trace
+            ctx = obs_spans.current_context()
             with self._send_lock:
-                conn.send((req_id, method, args))
+                conn.send((req_id, method, args, ctx))
             out = fut.result()
         finally:
             with self._cv:
                 self._outstanding -= windows
-                self._served_requests += requests
-                self._served_windows += windows
                 self._cv.notify_all()
+            self._m_served_requests.inc(requests, replica=self.name)
+            self._m_served_windows.inc(windows, replica=self.name)
         return out
+
+    def served_requests(self) -> int:
+        return int(self._m_served_requests.value(replica=self.name))
+
+    def served_windows(self) -> int:
+        return int(self._m_served_windows.value(replica=self.name))
 
     def predict_series(self, traffic: np.ndarray,
                        integrate: bool = True) -> np.ndarray:
@@ -632,8 +707,8 @@ class ProcessReplica:
                 "kind": self.kind,
                 "pid": self._proc.pid if self._proc is not None else None,
                 "outstanding_windows": self._outstanding,
-                "served_requests": self._served_requests,
-                "served_windows": self._served_windows,
+                "served_requests": self.served_requests(),
+                "served_windows": self.served_windows(),
                 "state": ("closed" if self._closed
                           else "draining" if self._draining else "live"),
             }

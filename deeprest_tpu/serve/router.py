@@ -40,6 +40,8 @@ import threading
 
 import numpy as np
 
+from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
 from deeprest_tpu.serve.replica import EngineReplica, clone_backend
 from deeprest_tpu.serve.server import ServingError
 
@@ -112,22 +114,44 @@ class WeightedAdmission:
         self._inflight = 0
         self._waiting: dict[str, collections.deque[_Waiter]] = {}
         self._credit: dict[str, float] = {}
-        self._stats = {"admitted": 0, "rejected": 0, "queued": 0}
-        self._tenant_stats: dict[str, dict[str, int]] = {}
+        # Admission counters ARE obs metrics now (one source of truth):
+        # stats() / the autoscaler's demand read / the /metrics
+        # exposition all read these same objects.  Per-instance — a
+        # rebuilt plane re-exposes its fresh counters (obs registry
+        # replace-by-name) while tests with several routers keep correct
+        # per-instance values.  "queued" is monotone (requests that ever
+        # waited), same meaning as the historical dict field.
+        self._m_admission = obs_metrics.Counter(
+            "deeprest_admission_requests_total",
+            "admission outcomes across the serving plane",
+            labelnames=("outcome",))
+        self._m_tenants = obs_metrics.Counter(
+            "deeprest_admission_tenant_requests_total",
+            "per-tenant admission outcomes (X-Tenant WRR key)",
+            labelnames=("tenant", "outcome"))
+        self._m_in_plane = obs_metrics.Histogram(
+            "deeprest_in_plane_latency_seconds",
+            "admission grant -> response written (the latency window "
+            "the admission bound controls)")
+        for m in (self._m_admission, self._m_tenants, self._m_in_plane):
+            obs_metrics.REGISTRY.expose(m)
         # IN-PLANE latency window (admission grant → response written):
         # the portion of request latency the admission bound actually
         # controls — client-observed latency additionally carries the
         # HTTP layer's thread scheduling, which no admission policy can
-        # cap on a saturated host.
+        # cap on a saturated host.  The deque keeps the exact-percentile
+        # JSON view; the histogram above is the scrapeable twin.
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=8192)
 
     def _weight(self, tenant: str) -> float:
         return (self.config.tenant_weights or {}).get(tenant, 1.0)
 
-    def _tstat(self, tenant: str) -> dict:
-        return self._tenant_stats.setdefault(
-            tenant, {"admitted": 0, "rejected": 0})
+    def _note(self, tenant: str, outcome: str) -> None:
+        """One admission outcome into the obs counters (the single
+        bookkeeping the JSON stats, /metrics, and the autoscaler share)."""
+        self._m_admission.inc(outcome=outcome)
+        self._m_tenants.inc(tenant=tenant, outcome=outcome)
 
     def try_acquire(self, tenant: str | None) -> "_AdmissionTicket":
         cfg = self.config
@@ -137,13 +161,11 @@ class WeightedAdmission:
             if self._inflight < cfg.admission_depth and not any(
                     self._waiting.values()):
                 self._inflight += 1
-                self._stats["admitted"] += 1
-                self._tstat(tenant)["admitted"] += 1
+                self._note(tenant, "admitted")
                 return _AdmissionTicket(self, tenant)
             total_waiting = sum(len(q) for q in self._waiting.values())
             if cfg.max_wait_s <= 0 or total_waiting >= cfg.waiting_bound:
-                self._stats["rejected"] += 1
-                self._tstat(tenant)["rejected"] += 1
+                self._note(tenant, "rejected")
                 raise AdmissionError(
                     f"serving plane saturated ({self._inflight} in flight, "
                     f"{total_waiting} waiting); retry after "
@@ -151,12 +173,11 @@ class WeightedAdmission:
             waiter = _Waiter()
             self._waiting.setdefault(tenant, collections.deque()).append(
                 waiter)
-            self._stats["queued"] += 1
+            self._note(tenant, "queued")
         waiter.event.wait(cfg.max_wait_s)
         with self._lock:
             if waiter.granted:
-                self._stats["admitted"] += 1
-                self._tstat(tenant)["admitted"] += 1
+                self._note(tenant, "admitted")
                 return _AdmissionTicket(self, tenant)
             # timed out: withdraw from the queue (the grant path may race
             # us — granted wins, checked again under the lock above)
@@ -166,11 +187,9 @@ class WeightedAdmission:
                 if not q:
                     del self._waiting[tenant]
             if waiter.granted:          # grant landed between wait and lock
-                self._stats["admitted"] += 1
-                self._tstat(tenant)["admitted"] += 1
+                self._note(tenant, "admitted")
                 return _AdmissionTicket(self, tenant)
-            self._stats["rejected"] += 1
-            self._tstat(tenant)["rejected"] += 1
+            self._note(tenant, "rejected")
         raise AdmissionError(
             f"serving plane saturated (waited {cfg.max_wait_s:.3f}s); "
             f"retry after {cfg.retry_after_s:.3f}s", cfg.retry_after_s)
@@ -180,6 +199,7 @@ class WeightedAdmission:
             self._inflight -= 1
             if in_plane_s is not None:
                 self._latencies.append(in_plane_s)
+                self._m_in_plane.observe(in_plane_s)
             self._grant_next_locked()
 
     def reset_window(self) -> None:
@@ -206,16 +226,27 @@ class WeightedAdmission:
             self._inflight += 1
             waiter.event.set()
 
+    def counts(self) -> dict[str, int]:
+        """Monotone admission outcome totals straight off the obs
+        counters (what the autoscaler's demand read consumes)."""
+        series = self._m_admission.series()
+        return {k: int(series.get((k,), 0.0))
+                for k in ("admitted", "rejected", "queued")}
+
     def stats(self) -> dict:
+        tenants: dict[str, dict[str, int]] = {}
+        for (tenant, outcome), v in self._m_tenants.series().items():
+            if outcome in ("admitted", "rejected"):
+                tenants.setdefault(
+                    tenant, {"admitted": 0, "rejected": 0})[outcome] = int(v)
         with self._lock:
             lats = sorted(self._latencies)
             out = {
                 "depth": self.config.admission_depth,
                 "inflight": self._inflight,
                 "waiting": sum(len(q) for q in self._waiting.values()),
-                **self._stats,
-                "tenants": {t: dict(s)
-                            for t, s in sorted(self._tenant_stats.items())},
+                **self.counts(),
+                "tenants": {t: tenants[t] for t in sorted(tenants)},
             }
 
         def pct(p):
@@ -231,24 +262,22 @@ class WeightedAdmission:
 
 class _AdmissionTicket:
     """Context manager covering one admitted request end-to-end; its
-    lifetime is the request's IN-PLANE latency sample."""
+    lifetime is the request's IN-PLANE latency sample (measured through
+    the obs Stopwatch — the sanctioned clock OB001 points hot modules
+    at — and observed into the admission latency histogram on release)."""
 
-    __slots__ = ("_admission", "tenant", "_t0")
+    __slots__ = ("_admission", "tenant", "_sw")
 
     def __init__(self, admission: WeightedAdmission, tenant: str):
-        import time
-
         self._admission = admission
         self.tenant = tenant
-        self._t0 = time.monotonic()
+        self._sw = obs_metrics.Stopwatch()
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
-        import time
-
-        self._admission.release(in_plane_s=time.monotonic() - self._t0)
+        self._admission.release(in_plane_s=self._sw.elapsed())
         return False
 
 
@@ -272,6 +301,12 @@ class ReplicaRouter:
         self._batching = batching
         self._autoscaler_decision: dict | None = None
         self._meta = self._probe_meta(replicas[0])
+        # Render-time /metrics view over the replica plane: everything it
+        # publishes is already counted by the replicas' and admission's
+        # own obs counters — the collector adds zero steady-state cost.
+        # Replace-by-name: the newest router owns the exposition.
+        obs_metrics.REGISTRY.register_collector("router",
+                                                self._collect_metrics)
 
     @staticmethod
     def _probe_meta(replica) -> dict:
@@ -423,11 +458,20 @@ class ReplicaRouter:
 
     def predict_series(self, traffic: np.ndarray,
                        integrate: bool = True) -> np.ndarray:
-        return self._pick().predict_series(traffic, integrate=integrate)
+        replica = self._pick()
+        with obs_spans.RECORDER.span("router.dispatch",
+                                     component="deeprest-router") as sp:
+            sp.tag(replica=replica.name, series=1)
+            return replica.predict_series(traffic, integrate=integrate)
 
     def predict_series_many(self, series_list, integrate: bool = True):
-        return self._pick().predict_series_many(series_list,
-                                                integrate=integrate)
+        replica = self._pick()
+        series_list = list(series_list)
+        with obs_spans.RECORDER.span("router.dispatch",
+                                     component="deeprest-router") as sp:
+            sp.tag(replica=replica.name, series=len(series_list))
+            return replica.predict_series_many(series_list,
+                                               integrate=integrate)
 
     # -- replica plane management ----------------------------------------
 
@@ -568,6 +612,53 @@ class ReplicaRouter:
             r.close()
 
     # -- observability ---------------------------------------------------
+
+    def demand_totals(self) -> dict[str, int]:
+        """Cumulative plane demand off the obs counters: requests served
+        by any replica plus requests shed by admission.  The autoscaler's
+        observation source (one source of truth with /healthz and
+        /metrics — the counters behind all three are the same objects)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        served = sum(int(r.served_requests()) for r in replicas)
+        return {"served": served,
+                "shed": self.admission.counts()["rejected"]}
+
+    def _collect_metrics(self, sink) -> None:
+        """The /metrics view of the replica plane (render-time only)."""
+        with self._lock:
+            replicas = list(self._replicas)
+            dispatched = self._dispatched
+            reloads = self._reloads
+            decision = self._autoscaler_decision
+        sink.gauge("deeprest_router_replicas", len(replicas),
+                   help="live replica count behind the routing front")
+        sink.counter("deeprest_router_dispatched_total", dispatched,
+                     help="requests dispatched by the router")
+        sink.counter("deeprest_router_rolling_reloads_total", reloads,
+                     help="zero-downtime rolling reloads completed")
+        for r in replicas:
+            labels = {"replica": r.name}
+            sink.gauge("deeprest_replica_outstanding_windows",
+                       r.outstanding(),
+                       help="windows currently dispatched to the replica",
+                       labels=labels)
+            sink.counter("deeprest_replica_served_requests_total",
+                         r.served_requests(),
+                         help="requests served by the replica",
+                         labels=labels)
+            sink.counter("deeprest_replica_served_windows_total",
+                         r.served_windows(),
+                         help="windows served by the replica",
+                         labels=labels)
+        if decision is not None:
+            sink.gauge("deeprest_autoscaler_desired_replicas",
+                       decision.get("desired", 0),
+                       help="latest autoscaler decision")
+        cache = self.jit_cache_size()
+        if cache is not None:
+            sink.gauge("deeprest_plane_jit_executables", cache,
+                       help="compiled executables across distinct stacks")
 
     def router_stats(self) -> dict:
         with self._lock:

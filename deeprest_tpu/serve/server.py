@@ -12,10 +12,13 @@ Routes (all JSON):
 
     GET  /healthz             liveness + model dims
     GET  /v1/meta             metric names, quantiles, window, endpoints
+    GET  /metrics             Prometheus text exposition (deeprest_tpu/obs)
+    GET  /v1/spans            retained spans as Jaeger query-API JSON
     POST /v1/predict          {"traffic": [[F floats] x T]}          → [T,E,Q]
     POST /v1/whatif           {"expected_traffic": [{endpoint: n}xT]} → series
     POST /v1/whatif/scaling   {"baseline_traffic", "hypothetical_traffic"}
     POST /v1/anomaly          {"traffic", "observed", "tolerance"?, "min_run"?}
+    POST /v1/profile          {"seconds"?, "out_dir"?} → jax.profiler window
 
 Built on the stdlib ThreadingHTTPServer: one small dependency-free binary
 surface.  Concurrent requests do NOT each pay a device dispatch: the
@@ -43,6 +46,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
 from deeprest_tpu.serve.anomaly import AnomalyDetector
 from deeprest_tpu.serve.batcher import BatcherConfig, MicroBatcher
 from deeprest_tpu.serve.whatif import WhatIfEstimator
@@ -185,6 +190,16 @@ class PredictionService:
         self.backend = backend
         self._synthesizer = synthesizer
         self._reloader = reloader
+        # HTTP-plane metrics (per-service objects, exposed replace-by-name
+        # into the default registry so the newest plane owns /metrics).
+        self._m_requests = obs_metrics.REGISTRY.expose(obs_metrics.Counter(
+            "deeprest_http_requests_total",
+            "requests by route and status code",
+            labelnames=("route", "code")))
+        self._m_latency = obs_metrics.REGISTRY.expose(obs_metrics.Histogram(
+            "deeprest_http_request_seconds",
+            "wall time handling a request, by route",
+            labelnames=("route",)))
         # Guards the SWAPPABLE serving state below: ThreadingHTTPServer
         # runs every request on its own thread, and maybe_reload() swaps
         # these mid-flight (found by graftlint TH001: /healthz read the
@@ -201,6 +216,11 @@ class PredictionService:
                        if synthesizer is not None else None)
         if batching is not None:
             self.enable_batching(batching)
+        # Registered LAST: the render-time collector snapshots state the
+        # lines above create (replace-by-name — the newest plane owns the
+        # /metrics exposition).
+        obs_metrics.REGISTRY.register_collector(
+            "serving", self._collect_metrics)
 
     # -- swappable-state management (all writes under self._lock) --------
 
@@ -315,6 +335,80 @@ class PredictionService:
 
         return contextlib.nullcontext()
 
+    def _note_request(self, route: str, status: int) -> None:
+        """One row in the HTTP request counter (called by the handler as
+        each response is written; metric objects carry their own locks)."""
+        self._m_requests.inc(route=route, code=str(status))
+
+    def _observe_latency(self, route: str, stopwatch) -> None:
+        stopwatch.observe_into(self._m_latency, route=route)
+
+    def _collect_metrics(self, sink) -> None:
+        """Render-time /metrics view of serving state already counted
+        elsewhere (reload counter, batcher queue, fused-engine pages, jit
+        cache) — no hot-path cost, one source of truth with /healthz."""
+        pred, _, batcher, reloads = self._snapshot()
+        sink.counter("deeprest_serving_reloads_total", reloads,
+                     help="backend hot reloads")
+        if batcher is not None:
+            s = batcher.stats()
+            sink.gauge("deeprest_batcher_queue_windows",
+                       s["queue_depth_windows"],
+                       help="windows pending in the micro-batcher queue")
+        fused = getattr(pred, "fused", None)
+        if fused is not None:
+            s = fused.stats()
+            sink.counter("deeprest_fused_pages_total", s["pages"],
+                         help="fused rolled-inference pages dispatched")
+            sink.counter("deeprest_fused_windows_total", s["windows"],
+                         help="windows through the fused engine")
+        cache = getattr(pred, "jit_cache_size", None)
+        if callable(cache):
+            n = cache()
+            if n is not None:
+                sink.gauge("deeprest_plane_jit_executables", n,
+                           help="compiled executables across distinct "
+                                "stacks")
+        rec = obs_spans.RECORDER.stats()
+        sink.gauge("deeprest_obs_spans_retained", rec["retained"],
+                   help="spans currently in the recorder ring")
+        sink.counter("deeprest_obs_spans_recorded_total", rec["recorded"],
+                     help="spans committed since process start")
+
+    def metrics_text(self) -> str:
+        """The Prometheus text exposition (``GET /metrics``)."""
+        return obs_metrics.REGISTRY.render()
+
+    def spans_jaeger(self) -> dict:
+        """Retained spans as Jaeger query-API JSON (``GET /v1/spans``) —
+        the payload ``deeprest ingest --traces`` consumes for the
+        self-ingestion loop (obs/export.py)."""
+        from deeprest_tpu.obs.export import spans_to_jaeger
+
+        return spans_to_jaeger(obs_spans.RECORDER.snapshot())
+
+    def profile(self, payload: dict) -> dict:
+        """On-demand ``jax.profiler`` capture window (``POST
+        /v1/profile``): the handler blocks for the window while the other
+        handler threads keep serving — the trace captures the plane under
+        its live load.  One window at a time (409 when busy)."""
+        import tempfile
+
+        from deeprest_tpu.obs import profiler
+
+        try:
+            seconds = float(payload.get("seconds", 1.0))
+        except (TypeError, ValueError) as e:
+            raise ServingError(f"bad seconds: {e}") from None
+        out_dir = payload.get("out_dir") or tempfile.mkdtemp(
+            prefix="deeprest-profile-")
+        try:
+            return profiler.capture(out_dir, seconds)
+        except profiler.ProfilerBusy as e:
+            raise ServingError(str(e), status=409) from None
+        except ValueError as e:
+            raise ServingError(str(e)) from None
+
     def healthz(self) -> dict:
         pred, _, batcher, reloads = self._snapshot()
         out = {
@@ -345,6 +439,10 @@ class PredictionService:
             # (additive key; the wire protocol's existing fields are
             # untouched)
             out["fused_infer"] = fused.stats()
+        # span-recorder health (additive key): enabled flag, ring
+        # retention, eviction pressure — the JSON twin of the /metrics
+        # deeprest_obs_* gauges
+        out["obs"] = obs_spans.RECORDER.stats()
         return out
 
     def meta(self) -> dict:
@@ -471,13 +569,19 @@ class PredictionService:
         } for r in reports], "flagged": [r.metric for r in reports if r.flagged]}
 
 
-_GET_ROUTES = {"/healthz": "healthz", "/v1/meta": "meta"}
+_GET_ROUTES = {"/healthz": "healthz", "/v1/meta": "meta",
+               "/v1/spans": "spans_jaeger"}
 _POST_ROUTES = {
     "/v1/predict": "predict",
     "/v1/whatif": "whatif_estimate",
     "/v1/whatif/scaling": "whatif_scaling",
     "/v1/anomaly": "anomaly",
 }
+# Ops routes skip the admission gate: shedding a profiler request under
+# serving overload would make the plane unobservable exactly when it is
+# interesting, and a capture window must not hold an admission slot for
+# its whole (seconds-long) duration.
+_POST_OPS_ROUTES = {"/v1/profile": "profile"}
 
 
 class PredictionServer:
@@ -504,16 +608,33 @@ class PredictionServer:
 
             def _reply(self, status: int, body: dict,
                        headers: dict | None = None):
-                blob = json.dumps(body).encode()
+                self._reply_raw(status, json.dumps(body).encode(),
+                                "application/json", headers)
+
+            def _reply_raw(self, status: int, blob: bytes,
+                           content_type: str,
+                           headers: dict | None = None):
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(blob)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(blob)
+                outer.service._note_request(self.path, status)
 
             def do_GET(self):
+                if self.path == "/metrics":
+                    # Prometheus text exposition (0.0.4) — the scrape
+                    # target the reference deploys a whole Prometheus to
+                    # feed from (deploy/README.md has the scrape-config
+                    # snippet for this plane).
+                    try:
+                        return self._reply_raw(
+                            200, outer.service.metrics_text().encode(),
+                            obs_metrics.PROMETHEUS_CONTENT_TYPE)
+                    except Exception as e:
+                        return self._reply(500, {"error": f"internal: {e}"})
                 name = _GET_ROUTES.get(self.path)
                 if name is None:
                     return self._reply(404, {"error": f"no route {self.path}"})
@@ -524,26 +645,47 @@ class PredictionServer:
                     self._reply(500, {"error": f"internal: {e}"})
 
             def do_POST(self):
-                name = _POST_ROUTES.get(self.path)
+                ops_name = _POST_OPS_ROUTES.get(self.path)
+                name = ops_name or _POST_ROUTES.get(self.path)
                 if name is None:
                     return self._reply(404, {"error": f"no route {self.path}"})
+                sw = obs_metrics.Stopwatch()
                 try:
-                    outer.service.maybe_reload()
-                    length = int(self.headers.get("Content-Length", 0))
-                    # the body must be drained either way (keep-alive
-                    # framing), but it stays UNPARSED until admission: a
-                    # shed request costs a read, not a JSON decode
-                    raw = self.rfile.read(length)
-                    # multi-tenant fairness key (weighted round-robin in
-                    # the router's admission gate); absent header = the
-                    # shared default tenant
-                    tenant = self.headers.get("X-Tenant")
-                    with outer.service.admission(tenant):
-                        payload = json.loads(raw or b"{}")
-                        if not isinstance(payload, dict):
-                            raise ServingError(
-                                "request body must be a JSON object")
-                        self._reply(200,
+                    # the request-scoped trace root: every span recorded
+                    # below it (router dispatch, replica, batcher worker,
+                    # fused engine — across threads and worker processes)
+                    # shares this request's trace id
+                    with obs_spans.RECORDER.span(
+                            self.path,
+                            component="deeprest-predictor") as root:
+                        outer.service.maybe_reload()
+                        length = int(self.headers.get("Content-Length", 0))
+                        # the body must be drained either way (keep-alive
+                        # framing), but it stays UNPARSED until admission:
+                        # a shed request costs a read, not a JSON decode
+                        raw = self.rfile.read(length)
+                        # multi-tenant fairness key (weighted round-robin
+                        # in the router's admission gate); absent header =
+                        # the shared default tenant
+                        tenant = self.headers.get("X-Tenant")
+                        root.tag(tenant=tenant or "default")
+                        if ops_name is not None:
+                            # ops route: no admission gate (see
+                            # _POST_OPS_ROUTES)
+                            payload = json.loads(raw or b"{}")
+                            if not isinstance(payload, dict):
+                                raise ServingError(
+                                    "request body must be a JSON object")
+                            self._reply(
+                                200, getattr(outer.service, name)(payload))
+                        else:
+                            with outer.service.admission(tenant):
+                                payload = json.loads(raw or b"{}")
+                                if not isinstance(payload, dict):
+                                    raise ServingError(
+                                        "request body must be a JSON object")
+                                self._reply(
+                                    200,
                                     getattr(outer.service, name)(payload))
                 except ServingError as e:
                     self._reply(e.status, {"error": str(e)},
@@ -552,6 +694,8 @@ class PredictionServer:
                     self._reply(400, {"error": f"bad JSON: {e}"})
                 except Exception as e:  # handler bug: 500, not a dead socket
                     self._reply(500, {"error": f"internal: {e}"})
+                finally:
+                    outer.service._observe_latency(self.path, sw)
 
         class _Server(ThreadingHTTPServer):
             # The stdlib default listen backlog (5) drops SYNs when a

@@ -60,7 +60,16 @@ def format_report(report: Mapping[str, Mapping[str, Mapping[str, float]]]) -> st
 @dataclasses.dataclass
 class Throughput:
     """Steps/sec meter; ``jax.block_until_ready`` at the measurement edges
-    is the caller's responsibility."""
+    is the caller's responsibility.
+
+    Every ``stop()`` also publishes the measured window into the obs
+    metrics registry (``deeprest_train_steps_total`` /
+    ``deeprest_train_measured_seconds_total``) so the trainer's step-time
+    signal reaches ``GET /metrics`` scrapes and the self-ingestion loop
+    — this meter IS the obs layer's step-time source, which is why its
+    raw clock carries the OB001 suppression below rather than migrating
+    onto itself.
+    """
 
     steps: int = 0
     _t0: float | None = None
@@ -72,9 +81,19 @@ class Throughput:
     def stop(self, steps: int) -> None:
         if self._t0 is None:
             raise RuntimeError("Throughput.stop() without start()")
-        self.elapsed += time.perf_counter() - self._t0
+        # graftlint: disable=OB001 -- this meter IS the obs step-time source; the registry publish below is the migration target other sites use
+        window = time.perf_counter() - self._t0
+        self.elapsed += window
         self.steps += steps
         self._t0 = None
+        from deeprest_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.REGISTRY.counter(
+            "deeprest_train_steps_total",
+            "train steps inside measured throughput windows").inc(steps)
+        obs_metrics.REGISTRY.counter(
+            "deeprest_train_measured_seconds_total",
+            "wall seconds of measured train windows").inc(window)
 
     @property
     def steps_per_sec(self) -> float:

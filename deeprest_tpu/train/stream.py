@@ -73,6 +73,7 @@ import numpy as np
 
 from deeprest_tpu.config import Config, FeaturizeConfig
 from deeprest_tpu.data.featurize import CallPathSpace
+from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.data.schema import Bucket
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
 from deeprest_tpu.train.data import (
@@ -701,6 +702,22 @@ class StreamingTrainer:
         r.etl_stall_s = stall_s
         r.etl_lag_buckets = lag
         r.etl_dropped = dropped
+        # ETL-health signals into the obs registry (one write per refresh
+        # — never on the poll/ingest path): the scrapeable twin of the
+        # RefreshResult fields the stream CLI prints.
+        reg = obs_metrics.REGISTRY
+        reg.counter("deeprest_stream_refreshes_total",
+                    "fine-tune refreshes performed").inc()
+        reg.counter("deeprest_etl_stall_seconds_total",
+                    "train-thread seconds blocked on host ETL").inc(stall_s)
+        reg.gauge("deeprest_etl_lag_buckets",
+                  "featurized-but-not-ingested backlog at refresh").set(lag)
+        reg.gauge("deeprest_etl_dropped_total",
+                  "cumulative malformed lines dropped by the tailer").set(
+                      dropped)
+        reg.gauge("deeprest_stream_retained_buckets",
+                  "buckets retained in the streaming corpus").set(
+                      r.num_buckets)
         return r
 
     def _run_serial(self, tailer, max_refreshes, should_stop,
@@ -715,10 +732,12 @@ class StreamingTrainer:
                 return
             got = tailer.poll()
             if got:
-                w0 = time.monotonic()
+                # Stopwatch (obs/metrics.py): the sanctioned elapsed-time
+                # clock OB001 migrates hot serve/train modules onto.
+                sw = obs_metrics.Stopwatch()
                 for bucket in got:
                     self.ingest(bucket)
-                stall += time.monotonic() - w0
+                stall += sw.elapsed()
             if self.ready():
                 yield self._finish_refresh(
                     stall, 0, int(getattr(tailer, "dropped", 0)))
@@ -771,13 +790,13 @@ class StreamingTrainer:
                 if deadline_s is not None \
                         and time.monotonic() - t0 > deadline_s:
                     return
-                w0 = time.monotonic()
+                sw = obs_metrics.Stopwatch()
                 batch = buf.get(timeout=self.stream.poll_interval_s)
                 if batch:
                     # Only waits that produced data count as ETL stall —
                     # an idle timeout is the source's cadence, not the
                     # featurizer falling behind.
-                    stall += time.monotonic() - w0
+                    stall += sw.elapsed()
                     for feat in batch:
                         self._ingest_featurized(feat)
                 if self.ready():

@@ -27,6 +27,7 @@ import optax
 
 from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU, fold_feature_mask
+from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
     feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
@@ -346,6 +347,42 @@ class Trainer:
                 {"params": params}, xb, deterministic=True
             )
         )
+        # Training-plane obs metrics (process-wide registry singletons —
+        # step time itself rides in via Throughput.stop): superstep
+        # dispatch counts, the designed host-readback counter, and the
+        # compile-event gauge fed from the jit cache probes.  One
+        # increment per epoch/superstep/log-boundary — never per step.
+        self._m_dispatches = obs_metrics.REGISTRY.counter(
+            "deeprest_train_superstep_dispatches_total",
+            "fused lax.scan superstep dispatches")
+        self._m_readbacks = obs_metrics.REGISTRY.counter(
+            "deeprest_train_readbacks_total",
+            "designed device->host readbacks by sink",
+            labelnames=("sink",))
+        self._m_executables = obs_metrics.REGISTRY.gauge(
+            "deeprest_train_jit_executables",
+            "compiled executables across the trainer's jitted programs "
+            "(compile events = increases)")
+
+    def _jit_cache_size(self) -> int | None:
+        """Total compiled-executable count across the trainer's jitted
+        programs (None when the running jax version has no cache probe) —
+        the compile-event source for the obs gauge and the no-recompile
+        probes' shared hook."""
+        sizes = []
+        for fn in (self._train_step, self._train_step_indexed,
+                   self._superstep, self._accum_superstep,
+                   self._eval_step, self._eval_step_indexed,
+                   self._predict_step, self._pin_state):
+            probe = getattr(fn, "_cache_size", None)
+            if callable(probe):
+                sizes.append(int(probe()))
+        return sum(sizes) if sizes else None
+
+    def _publish_epoch_metrics(self) -> None:
+        cache = self._jit_cache_size()
+        if cache is not None:
+            self._m_executables.set(cache)
 
     # ------------------------------------------------------------------
 
@@ -553,15 +590,18 @@ class Trainer:
             else:
                 steps += 1
             if log_every and self._global_step % log_every == 0:
+                self._m_readbacks.inc(sink="log_boundary")
                 # graftlint: disable=JX003 -- designed sink: one scalar readback per log_every steps, the logging contract
                 print(f"step {self._global_step}: loss {float(loss):.6f}")
         jax.block_until_ready(state.params)
         if measuring:
             self.throughput.stop(steps)
+        self._publish_epoch_metrics()
         # One stacked host readback for the epoch mean instead of a
         # device round-trip per element; f64 accumulation over the f32
         # per-step values reproduces the historical list-of-floats mean
         # bit-for-bit.
+        self._m_readbacks.inc(sink="epoch_losses")
         epoch_losses = np.asarray(jnp.stack(losses))
         self._last_epoch_losses = epoch_losses
         return state, float(np.mean(epoch_losses, dtype=np.float64))
@@ -609,16 +649,20 @@ class Trainer:
             prev = self._global_step
             self._global_step += real
             if log_every and prev // log_every != self._global_step // log_every:
+                self._m_readbacks.inc(sink="log_boundary")
                 # graftlint: disable=JX003 -- designed sink: one [S] readback per superstep, only when a log boundary passed
                 vals = np.asarray(losses_c)     # one readback, ≥1 boundary
                 for gs in range(prev + 1, self._global_step + 1):
                     if gs % log_every == 0:
                         print(f"step {gs}: loss {vals[gs - prev - 1]:.6f}")
+        self._m_dispatches.inc(starts.shape[0])
         jax.block_until_ready(state.params)
         if measuring:
             self.throughput.stop(steps)
+        self._publish_epoch_metrics()
         # Padding only ever trails the real steps, so [:num_steps] of the
         # concatenated chunks is exactly the epoch's per-step loss curve.
+        self._m_readbacks.inc(sink="epoch_losses")
         epoch_losses = np.asarray(jnp.concatenate(chunk_losses))[:num_steps]
         self._last_epoch_losses = epoch_losses
         return state, float(np.mean(epoch_losses, dtype=np.float64))

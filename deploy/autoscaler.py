@@ -95,14 +95,26 @@ class Autoscaler:
     # -- observation -----------------------------------------------------
 
     def sample(self, now: float | None = None) -> float:
-        """Record the router's cumulative served-request counter; returns
-        the observed rps since the previous sample (0.0 on the first)."""
+        """Record the plane's cumulative demand counters; returns the
+        observed rps since the previous sample (0.0 on the first).
+
+        Demand comes from the obs metrics registry's counters via
+        ``router.demand_totals()`` — the SAME objects /healthz and
+        ``GET /metrics`` read — not from a private re-derivation of the
+        stats JSON (one source of truth; router_stats stays as the
+        fallback for minimal router stand-ins in tests).
+        """
         now = time.monotonic() if now is None else now
-        stats = self.router.router_stats()
-        served = sum(r["served_requests"] for r in stats["replicas"])
+        demand = getattr(self.router, "demand_totals", None)
+        if callable(demand):
+            totals = demand()
+            served, rejected = totals["served"], totals["shed"]
+        else:
+            stats = self.router.router_stats()
+            served = sum(r["served_requests"] for r in stats["replicas"])
+            rejected = stats["admission"]["rejected"]
         # admission rejections are demand too: a saturated plane must
         # scale UP even though served throughput has flat-lined
-        rejected = stats["admission"]["rejected"]
         with self._lock:
             prev = self._samples[-1] if self._samples else None
             self._samples.append((now, served, rejected))

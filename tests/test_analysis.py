@@ -728,6 +728,81 @@ class Plain:
 # HY rules
 
 
+# ---------------------------------------------------------------------------
+# OB001: ad-hoc latency timers in hot modules
+
+
+OB001_BAD = """
+import time
+
+class Handler:
+    def handle(self, request):
+        t0 = time.monotonic()
+        result = work(request)
+        self.latency_s = time.monotonic() - t0
+        return result
+"""
+
+OB001_GOOD = """
+from deeprest_tpu.obs.metrics import Stopwatch
+
+class Handler:
+    def handle(self, request):
+        sw = Stopwatch()
+        result = work(request)
+        self.latency_s = sw.elapsed()
+        return result
+"""
+
+
+def test_ob001_pair():
+    assert_pair("OB001", OB001_BAD, OB001_GOOD, rel="serve/handler.py")
+
+
+def test_ob001_wall_clock_fires():
+    bad = """
+import time
+
+def measure():
+    start = time.time()
+    work()
+    return time.time() - start
+"""
+    fired = findings_for("OB001", bad, rel="train/loop.py")
+    assert fired and "time.time()" in fired[0].message
+
+
+def test_ob001_deadline_patterns_are_silent():
+    src = """
+import time
+
+def run(deadline_s):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:   # elapsed IN a compare
+        work()
+    deadline = time.monotonic() + 5.0           # remaining-time math
+    left = deadline - time.monotonic()          # timer on the right
+    return left
+"""
+    assert not findings_for("OB001", src, rel="serve/loop.py")
+
+
+def test_ob001_non_hot_modules_are_silent():
+    # host-side ETL and the workload simulator measure with numpy-era
+    # timers by design — only serve/ and train/ are on the watchlist
+    src = """
+import time
+
+def measure():
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+"""
+    assert not findings_for("OB001", src, rel="data/etl.py")
+    assert not findings_for("OB001", src, rel="workload/sim.py")
+    assert findings_for("OB001", src, rel="serve/hot.py")
+
+
 def test_hy001_unused_import_pair():
     bad = "import os\nimport sys\n\nprint(sys.argv)\n"
     good = "import sys\n\nprint(sys.argv)\n"
@@ -889,6 +964,6 @@ def test_rule_registry_complete():
     rules = all_rules()
     assert {"JX001", "JX002", "JX003", "JX004",
             "TH001", "TH002", "TH003", "TH004",
-            "HY001", "HY002"} <= set(rules)
+            "HY001", "HY002", "OB001"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards

@@ -1,0 +1,62 @@
+"""Tier-1 smoke for the obs overhead gate: `obs_bench.py --quick` must
+run end to end on every suite pass so the span/metric instrumentation on
+the serve + train hot paths cannot silently grow past its budget between
+full bench runs (same pattern as tests/test_etl_bench.py /
+test_infer_bench.py).  The committed benchmarks/obs_bench.json carries
+the full-mode measurement against the real 3% budget; the quick tier
+asserts the plumbing and a noise-tolerant bound."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "obs_bench.py")
+
+
+def test_quick_mode_emits_sound_json(tmp_path):
+    out = tmp_path / "obs_bench.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.load(open(out)) == result
+    assert result["schema_version"] == 1
+    assert result["quick"] is True
+    assert result["platform"] == "cpu"
+    assert result["pass"] is True
+    for side in ("serve", "train"):
+        assert result[side]["overhead_pct"] <= result["budget_pct"]
+    assert result["serve"]["off_calls_per_sec"] > 0
+    assert result["serve"]["on_calls_per_sec"] > 0
+    assert result["train"]["off_steps_per_sec"] > 0
+    assert result["obs_overhead_pct"] == max(
+        result["serve"]["overhead_pct"], result["train"]["overhead_pct"])
+
+
+def test_headline_line_for_bench_schema_v8():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--headline"],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert set(record) == {"obs_overhead_pct"}
+    assert 0.0 <= record["obs_overhead_pct"] <= 100.0
+
+
+def test_committed_full_record_passes_budget():
+    """The committed artifact is the acceptance evidence: full mode,
+    real 3% budget, pass=true."""
+    with open(os.path.join(REPO, "benchmarks", "obs_bench.json"),
+              encoding="utf-8") as f:
+        committed = json.load(f)
+    assert committed["quick"] is False
+    assert committed["budget_pct"] == 3.0
+    assert committed["pass"] is True
+    assert committed["obs_overhead_pct"] <= 3.0
