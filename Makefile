@@ -20,6 +20,11 @@
 #                         hot paths, obs off/on A/B, asserted <=3%
 #                         budget) — refreshes benchmarks/obs_bench.json;
 #                         the on-chip number rides benchmarks/tpu_queue.sh
+#   make tenk-bench       the 10k-endpoint sparse-first vertical (F=10240
+#                         featurize → ring → feed bytes → train → serve →
+#                         peak RSS, dense vs padded-COO) — refreshes
+#                         benchmarks/tenk_bench.json; the on-chip run
+#                         rides benchmarks/tpu_queue.sh tenk_vertical
 
 PYTHON ?= python
 
@@ -41,4 +46,8 @@ serve-bench-replicas:
 obs-bench:
 	$(PYTHON) benchmarks/obs_bench.py --out benchmarks/obs_bench.json
 
-.PHONY: lint native tsan bench-multichip serve-bench-replicas obs-bench
+tenk-bench:
+	$(PYTHON) benchmarks/tenk_bench.py --out benchmarks/tenk_bench.json
+
+.PHONY: lint native tsan bench-multichip serve-bench-replicas obs-bench \
+	tenk-bench

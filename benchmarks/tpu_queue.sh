@@ -90,6 +90,16 @@ step serve_bench_replicas 2400 env JAX_PLATFORMS=tpu python \
   benchmarks/serve_bench.py --replicas 1,2,4 \
   --replica-concurrency 16,64,256,1024 \
   --out benchmarks/serve_bench_tpu.json
+# 10k-endpoint sparse-first vertical on-chip (round 15): the committed
+# CPU tenk_bench.json banks the deterministic halves (feed bytes 80×,
+# month-scale RSS 127 MB) and CPU plumbing proofs; on the accelerator
+# the host→device byte cut is the number that matters — the tunneled
+# chip was the original 200× feed gap — and the scatter-densify runs on
+# the MXU-adjacent VPU instead of stealing matmul cycles from the one
+# host core.  Train/serve arms assert sparse≡dense loss/output parity
+# on-chip too.
+step tenk_vertical 2400 env JAX_PLATFORMS=tpu python \
+  benchmarks/tenk_bench.py --out benchmarks/tenk_bench_tpu.json
 # Observability overhead on-chip (round 14): the committed CPU
 # obs_bench.json proves the <=3% budget where spans are a visible
 # fraction of a millisecond-scale call; on the accelerator, per-call

@@ -205,7 +205,7 @@ def all_rules() -> dict[str, Rule]:
     import importlib
 
     for pack in ("rules_jax", "rules_threading", "rules_hygiene",
-                 "rules_obs"):
+                 "rules_obs", "rules_data"):
         importlib.import_module(f"deeprest_tpu.analysis.{pack}")
     return dict(_REGISTRY)
 

@@ -1,0 +1,91 @@
+"""Rule pack DN: sparse-first data-plane discipline.
+
+Round 15 made the traffic pipeline sparse-first end to end: at the
+10k-endpoint width a per-window call-path count vector is >99% zeros, so
+featurization emits ``(cols, counts)`` pairs, the streaming corpus keeps
+padded-COO rings, and densification happens ONCE, on device, inside the
+existing executables (ops/densify.py).  DN001 keeps the hot ingest/refresh
+modules from quietly re-growing ``[..., F]``-wide dense traffic
+allocations after that migration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeprest_tpu.analysis.core import (
+    Finding, Project, Rule, call_name, register,
+)
+
+
+@register
+class DN001DenseTrafficMaterialization(Rule):
+    id = "DN001"
+    title = ("dense [..., capacity]-wide traffic allocation in a "
+             "sparse-first hot module (carry padded-COO and densify on "
+             "device — ops/densify.py)")
+    guards = ("round 15: the sparse-first 10k-endpoint pipeline exists "
+              "precisely so F-wide dense traffic tensors (a month-scale "
+              "F=10240 retained corpus is ~3.5 GB of ring; a normalized "
+              "window stack ~10 GB) never materialize on the ingest/"
+              "refresh hot paths — featurize emits (cols, counts), the "
+              "ring stores padded-COO, and the one densify is an "
+              "on-device scatter inside the staged executables.  A "
+              "np.zeros/np.empty/np.ones/np.full whose trailing shape "
+              "dimension is a capacity/feature width in train/stream.py "
+              "or data/featurize.py reintroduces exactly that "
+              "allocation; the pinned dense REFERENCE paths carry "
+              "reasoned suppressions instead of silent exemptions")
+
+    # Watchlist: the two modules the sparse-first migration converted.
+    # Component-wise suffix match (the JX003 lesson: bare-name lists
+    # silently exempt moved files).
+    WATCH = (("train", "stream.py"), ("data", "featurize.py"))
+
+    _ALLOCS = {"np.zeros", "np.empty", "np.ones", "np.full",
+               "numpy.zeros", "numpy.empty", "numpy.ones", "numpy.full"}
+    # Identifier fragments that mark a traffic-width dimension.  Matched
+    # against the LAST element of a literal shape tuple only — leading
+    # (time/batch) axes are fine, it is the trailing F that explodes.
+    _WIDTH_MARKERS = ("capacity", "feature_dim", "num_features")
+
+    def _is_hot(self, rel: str) -> bool:
+        parts = tuple(rel.replace("\\", "/").split("/"))
+        return any(parts[-2:] == w or parts[-len(w):] == w
+                   for w in self.WATCH if len(parts) >= len(w))
+
+    @classmethod
+    def _is_width_expr(cls, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and any(m in name.lower()
+                                        for m in cls._WIDTH_MARKERS):
+                return True
+        return False
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None or not self._is_hot(sf.rel):
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node.func) in self._ALLOCS
+                        and node.args):
+                    continue
+                shape = node.args[0]
+                if not (isinstance(shape, ast.Tuple) and shape.elts):
+                    continue
+                if self._is_width_expr(shape.elts[-1]):
+                    yield sf.finding(
+                        node, self.id,
+                        "dense traffic allocation with a capacity-wide "
+                        "trailing dimension in a sparse-first hot module: "
+                        "carry (cols, vals) padded-COO rows and let "
+                        "ops/densify.py scatter on device (suppress with "
+                        "a reason only for the pinned dense reference "
+                        "paths)")

@@ -90,6 +90,25 @@ def _add_fused_infer_args(p: argparse.ArgumentParser):
                         "cache-bound faster there — 4 on accelerators)")
 
 
+def _add_sparse_args(p: argparse.ArgumentParser, serving: bool = False):
+    where = ("the fused engine / shape ladder densifies on device"
+             if serving else
+             "the staged train feed densifies on device inside the "
+             "existing executables")
+    p.add_argument("--sparse-feed", action="store_true",
+                   help="sparse-first traffic pipeline (the 10k-endpoint "
+                        "tier): ship per-window call-path counts as "
+                        f"padded-COO (cols, vals) pairs — {where} "
+                        "(ops/densify.py) — cutting host->device bytes "
+                        "~F/(2K) at 10k width; bit-identical to the "
+                        "dense default (tests/test_sparse.py)")
+    p.add_argument("--sparse-nnz-cap", type=int, default=64, metavar="K",
+                   help="max nonzero traffic columns per bucket under "
+                        "--sparse-feed (the padded-COO row width); a "
+                        "fatter row raises rather than dropping call "
+                        "paths (default 64)")
+
+
 def _add_mesh_arg(p: argparse.ArgumentParser, serving: bool = False):
     extra = (" (serving: shardings resolve from the same partition-rule "
              "table training pins with — parallel/sharding.py — so "
@@ -294,7 +313,9 @@ def cmd_train(args) -> int:
                           device_data=args.device_data,
                           steps_per_superstep=args.steps_per_superstep,
                           grad_accum_windows=args.grad_accum_windows,
-                          grad_accum_mode=args.grad_accum_mode),
+                          grad_accum_mode=args.grad_accum_mode,
+                          sparse_feed=args.sparse_feed,
+                          sparse_nnz_cap=args.sparse_nnz_cap),
         mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
@@ -440,7 +461,9 @@ def cmd_stream(args) -> int:
                           log_every_steps=0,
                           steps_per_superstep=args.steps_per_superstep,
                           grad_accum_windows=args.grad_accum_windows,
-                          grad_accum_mode=args.grad_accum_mode),
+                          grad_accum_mode=args.grad_accum_mode,
+                          sparse_feed=args.sparse_feed,
+                          sparse_nnz_cap=args.sparse_nnz_cap),
         etl=EtlConfig(overlap=not args.no_etl_overlap,
                       queue_depth=args.etl_queue_depth),
     )
@@ -668,12 +691,16 @@ def cmd_serve(args) -> int:
                 page_windows=args.infer_page_windows,
                 coalesce_pages=args.infer_coalesce_pages,
                 coalesce_groups=args.batch_coalesce_groups,
+                sparse_feed=args.sparse_feed,
+                sparse_nnz_cap=args.sparse_nnz_cap,
                 mesh_config=mesh_cfg)
         pred = Predictor.from_checkpoint(
             args.ckpt_dir, ladder=ladder, fused=not args.no_fused_infer,
             page_windows=args.infer_page_windows,
             coalesce_pages=args.infer_coalesce_pages,
             coalesce_groups=args.batch_coalesce_groups,
+            sparse_feed=args.sparse_feed,
+            sparse_nnz_cap=args.sparse_nnz_cap,
             mesh_config=mesh_cfg)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
@@ -1072,6 +1099,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; 'flat' folds rows straight through the "
                         "kernel (max MXU row occupancy, ~1e-7 grad "
                         "reassociation); 'loop' is the unfused reference")
+    _add_sparse_args(p)
     _add_mesh_arg(p)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--plots-dir", default=None)
@@ -1145,6 +1173,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; 'flat' folds rows straight through the "
                         "kernel (max MXU row occupancy, ~1e-7 grad "
                         "reassociation); 'loop' is the unfused reference")
+    _add_sparse_args(p)
     p.add_argument("--refresh-buckets", type=int, default=60,
                    help="fine-tune after this many new buckets")
     p.add_argument("--finetune-epochs", type=int, default=2)
@@ -1294,6 +1323,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "/v1/spans exports them as Jaeger JSON for the "
                         "self-ingestion loop)")
     _add_fused_infer_args(p)
+    _add_sparse_args(p, serving=True)
     _add_mesh_arg(p, serving=True)
     p.set_defaults(fn=cmd_serve)
 

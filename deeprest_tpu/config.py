@@ -143,6 +143,22 @@ class TrainConfig:
     #   "loop" — G sequential unfused passes, summed grads: the pinned
     #     reference the other two are measured against.
     grad_accum_mode: str = "exact"
+    # Sparse-first traffic feed (the 10k-endpoint tier, ROADMAP item 4):
+    # traffic rows travel host→device as padded-COO ``(cols[K], vals[K])``
+    # pairs — >99% of a 10k-wide count vector is zeros — and densify to
+    # the model's static [.., F] via ONE on-device scatter inside the
+    # existing train/eval executables (ops/densify.py).  Staged feed
+    # bytes drop ~F/(2K) (~80× at F=10240, K=64); losses stay
+    # BIT-IDENTICAL to the dense reference (tests/test_sparse.py).  The
+    # dense path remains the default and the parity spec.  Requires the
+    # staged (device-resident) feed — incompatible with
+    # device_data="off".
+    sparse_feed: bool = False
+    # Max nonzero traffic columns per bucket row under sparse_feed; a
+    # fatter row RAISES (dropping call paths would corrupt the count
+    # vector).  Also the padded-COO row width, so it sizes both ring
+    # memory and feed bytes.
+    sparse_nnz_cap: int = 64
 
     def __post_init__(self):
         v = self.steps_per_superstep
@@ -160,6 +176,18 @@ class TrainConfig:
             raise ValueError(
                 f"TrainConfig.grad_accum_mode={self.grad_accum_mode!r}: "
                 f"must be 'exact', 'flat', or 'loop'")
+        if not isinstance(self.sparse_nnz_cap, int) \
+                or isinstance(self.sparse_nnz_cap, bool) \
+                or self.sparse_nnz_cap < 1:
+            raise ValueError(
+                f"TrainConfig.sparse_nnz_cap={self.sparse_nnz_cap!r}: "
+                f"must be an int >= 1")
+        if self.sparse_feed and self.device_data == "off":
+            raise ValueError(
+                "TrainConfig.sparse_feed=True requires the staged "
+                "(device-resident) feed — the on-device densify lives "
+                "inside the staged executables; set device_data to "
+                "'auto' or 'always'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,8 +267,23 @@ class InferConfig:
     # MINIMIZED at small pages — PERF.md "rolled inference"), 4 on
     # accelerators (256 recurrence rows at the default ladder).
     coalesce_pages: int | None = None
+    # Sparse-first serving feed (the serve-side twin of
+    # TrainConfig.sparse_feed): traffic series ship host→device as
+    # padded-COO ``(cols[K], vals[K])`` window pages and densify inside
+    # the fused executable (ops/densify.py) — ~F/(2K) fewer feed bytes
+    # at 10k-endpoint width, bit-identical non-delta outputs, and the
+    # executable count stays flat (one sparse program per rung).  Dense
+    # entry paths remain the default and the parity spec.
+    sparse_feed: bool = False
+    sparse_nnz_cap: int = 64
 
     def __post_init__(self):
+        if not isinstance(self.sparse_nnz_cap, int) \
+                or isinstance(self.sparse_nnz_cap, bool) \
+                or self.sparse_nnz_cap < 1:
+            raise ValueError(
+                f"InferConfig.sparse_nnz_cap={self.sparse_nnz_cap!r}: "
+                f"must be an int >= 1")
         if self.page_windows is not None and self.page_windows < 1:
             raise ValueError(
                 f"InferConfig.page_windows={self.page_windows}: must be "

@@ -214,6 +214,27 @@ class CallPathSpace:
             return out
         return counts.astype(np.float32)
 
+    def extract_sparse(self, traces: Sequence[Span]
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """Sparse twin of :meth:`extract`: ``(cols, counts)`` for the
+        nonzero columns only, off the same memoized ``_trace_columns``
+        walk.
+
+        At the 10k-endpoint width any one bucket touches a handful of
+        call paths — the dense vector is >99% zeros — so the sparse-first
+        pipeline (train/data.SparseSeriesRing → ops/densify.densify_coo)
+        carries ``(cols, counts)`` and defers densification to one
+        on-device scatter.  Columns are unique and ascending
+        (``np.unique``); counts are float32 integers, so scattering them
+        into a zero vector is BIT-IDENTICAL to :meth:`extract` for any
+        count below 2**24 (pinned by tests/test_sparse.py).  Freezes the
+        capacity on first call, exactly like ``extract``.
+        """
+        self.freeze()
+        cols, counts = np.unique(self._trace_columns(traces),
+                                 return_counts=True)
+        return cols.astype(np.int32), counts.astype(np.float32)
+
     def extract_reference(self, traces: Sequence[Span],
                           out: np.ndarray | None = None) -> np.ndarray:
         """The historical per-span accumulation loop, kept verbatim as the
@@ -225,7 +246,7 @@ class CallPathSpace:
             out[:] = 0.0
             x = out
         else:
-            x = np.zeros((self.capacity,), dtype=np.float32)
+            x = np.zeros((self.capacity,), dtype=np.float32)  # graftlint: disable=DN001 -- the pinned per-span accumulation REFERENCE is dense by definition; extract_sparse is the sparse-first path
         for trace in traces:
             for path, _ in trace.walk():
                 col = self.column_of(path)
@@ -236,7 +257,7 @@ class CallPathSpace:
     def extract_buckets(self, buckets: Sequence[Bucket]) -> np.ndarray:
         """[num_buckets, capacity] traffic matrix."""
         self.freeze()
-        out = np.zeros((len(buckets), self.capacity), dtype=np.float32)
+        out = np.zeros((len(buckets), self.capacity), dtype=np.float32)  # graftlint: disable=DN001 -- the offline [T, F] corpus matrix is this function's documented product (FeaturizedData.traffic); the streaming hot path uses extract_sparse + SparseSeriesRing instead
         for t, bucket in enumerate(buckets):
             self.extract(bucket.traces, out=out[t])
         return out

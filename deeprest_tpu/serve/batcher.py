@@ -89,7 +89,7 @@ class ShapeLadder:
     """
 
     def __init__(self, apply_fn, ladder=DEFAULT_LADDER,
-                 coalesce_groups: int = 1):
+                 coalesce_groups: int = 1, apply_sparse_fn=None):
         base = tuple(sorted({int(r) for r in ladder}))
         if not base or base[0] < 1:
             raise ValueError(f"bad shape ladder {ladder!r}")
@@ -97,6 +97,13 @@ class ShapeLadder:
             raise ValueError(
                 f"coalesce_groups {coalesce_groups} must be >= 1")
         self._apply = apply_fn
+        # Sparse staging (round 15): an optional second apply taking RAW
+        # padded-COO ``(cols[n, W, K], vals[n, W, K])`` window batches
+        # (densify + normalize live on device — ops/densify.py); COO
+        # chunks pad up the SAME rung ladder with zero rows, so the
+        # sparse plane compiles one executable per dispatched rung,
+        # exactly like the dense one.
+        self._apply_sparse = apply_sparse_fn
         self.base_ladder = base
         self.coalesce_groups = int(coalesce_groups)
         # Coalesced super-rungs (round 11): top·{2..G} join the ladder so
@@ -147,6 +154,45 @@ class ShapeLadder:
                 else:
                     self._compiled.add(rung)
             parts.append((self._apply(padded), len(chunk)))
+        return parts
+
+    def dispatch_sparse(self, cols: np.ndarray,
+                        vals: np.ndarray) -> list[tuple[object, int]]:
+        """COO staging twin of :meth:`dispatch`: stage + asynchronously
+        dispatch raw ``(cols[n, W, K], vals[n, W, K])`` padded-COO window
+        batches as ladder-padded chunks (padding rows are all-zero COO
+        rows, whose densified windows are all-zero — dropped by
+        materialize exactly like dense padding).  Host→device bytes per
+        window are ``W·K·8`` instead of ``W·F·4``."""
+        if self._apply_sparse is None:
+            raise ValueError("this ladder has no sparse apply; construct "
+                             "it with apply_sparse_fn (sparse_feed)")
+        cols = np.ascontiguousarray(cols, dtype=np.int32)
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        if cols.shape != vals.shape:
+            raise ValueError(f"padded-COO halves disagree: cols "
+                             f"{cols.shape} vs vals {vals.shape}")
+        parts: list[tuple[object, int]] = []
+        for lo in range(0, len(cols), self.max_rung):
+            c = cols[lo:lo + self.max_rung]
+            v = vals[lo:lo + self.max_rung]
+            n = len(c)
+            rung = self.rung_for(n)
+            if rung > n:
+                pc = np.zeros((rung, *c.shape[1:]), np.int32)
+                pv = np.zeros((rung, *v.shape[1:]), np.float32)
+                pc[:n] = c
+                pv[:n] = v
+                c, v = pc, pv
+            with self._lock:
+                self._calls += 1
+                self._windows += n
+                self._padded_windows += rung - n
+                if rung in self._compiled:
+                    self._rung_hits += 1
+                else:
+                    self._compiled.add(rung)
+            parts.append((self._apply_sparse(c, v), n))
         return parts
 
     @staticmethod
@@ -399,9 +445,11 @@ class BatchedBackendMixin:
     """
 
     def _init_batching(self, apply_fn, ladder=None,
-                       coalesce_groups: int = 1) -> None:
+                       coalesce_groups: int = 1,
+                       apply_sparse_fn=None) -> None:
         self.ladder = ShapeLadder(apply_fn, ladder or DEFAULT_LADDER,
-                                  coalesce_groups=coalesce_groups)
+                                  coalesce_groups=coalesce_groups,
+                                  apply_sparse_fn=apply_sparse_fn)
         self._batcher: MicroBatcher | None = None
 
     @property
@@ -428,3 +476,26 @@ class BatchedBackendMixin:
             except BatcherClosed:
                 pass      # hot-reload race: fall through to the direct path
         return self.ladder(x)
+
+    def apply_windows_sparse(self, cols: np.ndarray,
+                             vals: np.ndarray) -> np.ndarray:
+        """Padded-COO batch entry: RAW ``(cols[n, W, K], vals[n, W, K])``
+        windows → ``[n, W, E, Q]`` de-padded results, with densify AND
+        normalization on device (the dense entry takes pre-normalized
+        windows; the sparse one ships raw counts, the point of the form).
+
+        Dispatches straight through the shape ladder's sparse staging —
+        cross-request MicroBatcher coalescing stays a dense-plane
+        feature (long sparse series route through the fused engine, the
+        same routing argument as ``_route_fused``); backends without a
+        sparse apply densify on host, bit-exact by construction.
+        """
+        if self.ladder._apply_sparse is None:
+            from deeprest_tpu.ops.densify import densify_rows
+            from deeprest_tpu.data.windows import minmax_apply
+
+            dense = densify_rows(cols, vals, self.feature_dim)
+            return self.apply_windows(
+                minmax_apply(dense, self.x_stats).astype(np.float32))
+        return ShapeLadder.materialize(
+            self.ladder.dispatch_sparse(cols, vals))

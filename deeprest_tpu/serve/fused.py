@@ -111,7 +111,9 @@ class FusedRolledEngine:
                  median_index: int | None = None,
                  rungs=DEFAULT_FUSED_RUNGS,
                  page_windows: int | None = None,
-                 coalesce_pages: int | None = None):
+                 coalesce_pages: int | None = None,
+                 sparse_nnz_cap: int | None = None,
+                 feature_dim: int | None = None):
         import jax
 
         rung_set = {int(r) for r in rungs}
@@ -182,8 +184,25 @@ class FusedRolledEngine:
         if self._has_delta:
             self._delta_dev = jnp.asarray(self._delta)[None, None, :, None]
         self._jit = jax.jit(self._program)
+        # Sparse-first entry (InferConfig.sparse_feed): windows arrive as
+        # padded-COO ``(cols[R, W, K], vals[R, W, K])`` pages — ~F/(2K)
+        # fewer host→device bytes at 10k-endpoint width — and densify via
+        # ONE scatter-add (ops/densify.py) before the identical program
+        # body, so outputs match the dense pages bit-for-bit and the
+        # executable count stays flat: one sparse program per dispatched
+        # rung (rung × K-cap), same as the dense ladder.
+        self._sparse_nnz_cap = (int(sparse_nnz_cap)
+                                if sparse_nnz_cap is not None else None)
+        self._feature_dim = int(feature_dim) if feature_dim is not None \
+            else None
+        if self._sparse_nnz_cap is not None and self._feature_dim is None:
+            raise ValueError("sparse_nnz_cap requires feature_dim (the "
+                             "static dense width the scatter targets)")
+        self._jit_sparse = (jax.jit(self._program_sparse)
+                            if self._sparse_nnz_cap is not None else None)
         self._lock = threading.Lock()
         self._pages = 0
+        self._sparse_pages = 0
         self._windows = 0
         self._padded_windows = 0
         self._series = 0
@@ -242,11 +261,28 @@ class FusedRolledEngine:
                         integrated, preds)
         return out, carry_out
 
+    def _program_sparse(self, params, cols, vals, x_mn, x_rg, y_mn, y_rg,
+                        carry_in, g, seg, n_valid, integrate):
+        """Padded-COO twin of :meth:`_program`: one on-device scatter-add
+        rebuilds the raw ``[R, W, F]`` page, then the SAME body runs —
+        the densify is bit-exact (unique real columns + zero padding, see
+        ops/densify.py), so sparse pages match dense pages bit-for-bit.
+        """
+        from deeprest_tpu.ops.densify import densify_coo
+
+        x = densify_coo(cols, vals, self._feature_dim)
+        return self._program(params, x, x_mn, x_rg, y_mn, y_rg, carry_in,
+                             g, seg, n_valid, integrate)
+
     # -- host paging ----------------------------------------------------
 
     @property
     def page_windows(self) -> int:
         return self.page
+
+    @property
+    def sparse_enabled(self) -> bool:
+        return self._jit_sparse is not None
 
     def rung_for(self, n: int) -> int:
         for r in self.rungs:
@@ -271,7 +307,6 @@ class FusedRolledEngine:
                   for s in series_list]
         if not arrays:
             return []
-        feat = arrays[0].shape[1]
         metas = plan_windows([len(a) for a in arrays], w)
         # One span for the whole fused train of dispatches (per-page
         # spans would put recorder traffic inside the hot paging loop);
@@ -279,10 +314,51 @@ class FusedRolledEngine:
         with obs_spans.RECORDER.span("fused.predict",
                                      component="deeprest-engine") as sp:
             sp.tag(series=len(arrays), windows=len(metas))
-            return self._predict_many_inner(arrays, metas, feat, integrate,
-                                            jnp)
+            return self._predict_many_inner(arrays, metas, integrate, jnp)
 
-    def _predict_many_inner(self, arrays, metas, feat, integrate, jnp):
+    def predict_many_sparse(self, sparse_series_list, integrate: bool = True):
+        """Sparse-first entry: each series is a padded-COO
+        ``(cols[T_i, K], vals[T_i, K])`` pair (``CallPathSpace.
+        extract_sparse`` rows, or ``ops.densify.sparsify_rows`` output)
+        instead of dense ``[T_i, F]``; results are identical de-normalized
+        ``[T_i, E, Q]`` arrays, bit-for-bit equal to :meth:`predict_many`
+        on the equivalent dense series (tests/test_sparse.py).  Pages
+        ship as ``(cols, vals)`` — the ~F/(2K) feed-byte cut this entry
+        exists for — and densify inside the fused executable."""
+        if self._jit_sparse is None:
+            raise ValueError(
+                "sparse feed is not enabled on this engine; construct it "
+                "with sparse_nnz_cap/feature_dim (InferConfig.sparse_feed)")
+        import jax.numpy as jnp
+
+        w = self.window_size
+        k = self._sparse_nnz_cap
+        arrays = []
+        for cols, vals in sparse_series_list:
+            cols = np.ascontiguousarray(cols, dtype=np.int32)
+            vals = np.ascontiguousarray(vals, dtype=np.float32)
+            if cols.shape != vals.shape or cols.ndim != 2:
+                raise ValueError(
+                    f"sparse series must be matching [T, K] cols/vals "
+                    f"pairs, got {cols.shape} vs {vals.shape}")
+            if cols.shape[1] != k:
+                raise ValueError(
+                    f"sparse series K={cols.shape[1]} != engine nnz cap "
+                    f"{k}; pad rows to the configured --sparse-nnz-cap "
+                    f"(a per-request K would compile per-request "
+                    f"executables)")
+            arrays.append((cols, vals))
+        if not arrays:
+            return []
+        metas = plan_windows([len(c) for c, _ in arrays], w)
+        with obs_spans.RECORDER.span("fused.predict_sparse",
+                                     component="deeprest-engine") as sp:
+            sp.tag(series=len(arrays), windows=len(metas))
+            return self._predict_many_inner(arrays, metas, integrate, jnp,
+                                            sparse=True)
+
+    def _predict_many_inner(self, arrays, metas, integrate, jnp,
+                            sparse: bool = False):
         w = self.window_size
         # Coalesced dispatch stride: up to coalesce_pages pages per batch
         # (the super-rungs are in self.rungs, so rung_for always fits).
@@ -290,26 +366,46 @@ class FusedRolledEngine:
         carry = self._carry0
         dispatched = []
         pages = padded = 0
+        lengths = [len(a[0]) if sparse else len(a) for a in arrays]
         for lo in range(0, len(metas), page):
             chunk = metas[lo:lo + page]
             rung = self.rung_for(len(chunk))
-            x = np.zeros((rung, w, feat), np.float32)
             g = np.full((rung,), w - 1, np.int32)
             seg = np.zeros((rung,), np.bool_)
-            for row, (si, s, gg, is_first) in enumerate(chunk):
-                x[row] = arrays[si][s:s + w]
-                g[row] = gg
-                seg[row] = is_first
-            out, carry = self._jit(
-                self._params, jnp.asarray(x), self._x_mn, self._x_rg,
-                self._y_mn, self._y_rg, carry, jnp.asarray(g),
-                jnp.asarray(seg), np.int32(len(chunk)),
-                np.bool_(integrate))
+            if sparse:
+                k = self._sparse_nnz_cap
+                xc = np.zeros((rung, w, k), np.int32)
+                xv = np.zeros((rung, w, k), np.float32)
+                for row, (si, s, gg, is_first) in enumerate(chunk):
+                    cols_i, vals_i = arrays[si]
+                    xc[row] = cols_i[s:s + w]
+                    xv[row] = vals_i[s:s + w]
+                    g[row] = gg
+                    seg[row] = is_first
+                out, carry = self._jit_sparse(
+                    self._params, jnp.asarray(xc), jnp.asarray(xv),
+                    self._x_mn, self._x_rg, self._y_mn, self._y_rg,
+                    carry, jnp.asarray(g), jnp.asarray(seg),
+                    np.int32(len(chunk)), np.bool_(integrate))
+            else:
+                feat = arrays[0].shape[1]
+                x = np.zeros((rung, w, feat), np.float32)
+                for row, (si, s, gg, is_first) in enumerate(chunk):
+                    x[row] = arrays[si][s:s + w]
+                    g[row] = gg
+                    seg[row] = is_first
+                out, carry = self._jit(
+                    self._params, jnp.asarray(x), self._x_mn, self._x_rg,
+                    self._y_mn, self._y_rg, carry, jnp.asarray(g),
+                    jnp.asarray(seg), np.int32(len(chunk)),
+                    np.bool_(integrate))
             dispatched.append((out, chunk))
             pages += 1
             padded += rung - len(chunk)
         with self._lock:
             self._pages += pages
+            if sparse:
+                self._sparse_pages += pages
             self._windows += len(metas)
             self._padded_windows += padded
             self._series += len(arrays)
@@ -335,8 +431,8 @@ class FusedRolledEngine:
                 arr = inv
             if out_dims is None:
                 out_dims = arr.shape[2:]                   # (E, Q)
-                for si, a in enumerate(arrays):
-                    outs[si] = np.empty((len(a), *out_dims), np.float32)
+                for si, t in enumerate(lengths):
+                    outs[si] = np.empty((t, *out_dims), np.float32)
             for row, (si, s, _, _) in enumerate(chunk):
                 outs[si][s:s + w] = arr[row]   # later (ragged) window wins
         return outs
@@ -350,18 +446,25 @@ class FusedRolledEngine:
                 "page_windows": self.page,
                 "coalesce_pages": self.coalesce_pages,
                 "pages": self._pages,
+                "sparse_pages": self._sparse_pages,
                 "windows": self._windows,
                 "padded_windows": self._padded_windows,
                 "series": self._series,
                 "max_dispatch_rows": self._max_dispatch_rows,
                 "dispatched_rungs": sorted(self._compiled),
+                "sparse_nnz_cap": self._sparse_nnz_cap,
             }
 
     def cache_size(self) -> int | None:
-        """Compiled-executable count of the fused program (None when the
-        running jax version has no cache probe)."""
-        probe = getattr(self._jit, "_cache_size", None)
-        return int(probe()) if callable(probe) else None
+        """Compiled-executable count across the dense AND sparse fused
+        programs (None when the running jax version has no cache probe)."""
+        sizes = []
+        for fn in (self._jit, self._jit_sparse):
+            probe = getattr(fn, "_cache_size", None) if fn is not None \
+                else None
+            if callable(probe):
+                sizes.append(int(probe()))
+        return sum(sizes) if sizes else None
 
 
 class FusedInferenceMixin:
@@ -380,7 +483,8 @@ class FusedInferenceMixin:
 
     def _init_fused(self, apply_fn, params=(), enabled: bool = True,
                     page_windows: int | None = None,
-                    coalesce_pages: int | None = None) -> None:
+                    coalesce_pages: int | None = None,
+                    sparse_nnz_cap: int | None = None) -> None:
         if not enabled:
             self._fused = None
             return
@@ -389,7 +493,10 @@ class FusedInferenceMixin:
             params=params,
             delta_mask=self.delta_mask, median_index=self.median_index(),
             rungs=self.ladder.base_ladder, page_windows=page_windows,
-            coalesce_pages=coalesce_pages)
+            coalesce_pages=coalesce_pages,
+            sparse_nnz_cap=sparse_nnz_cap,
+            feature_dim=(self.feature_dim if sparse_nnz_cap is not None
+                         else None))
 
     @property
     def fused(self) -> FusedRolledEngine | None:
@@ -421,6 +528,10 @@ class FusedInferenceMixin:
         """
         traffic = np.asarray(traffic)
         if self._route_fused(len(traffic)):
+            sparse = self._maybe_sparsify([traffic])
+            if sparse is not None:
+                return self._fused.predict_many_sparse(
+                    sparse, integrate=integrate)[0]
             return self._fused.predict_many([traffic], integrate=integrate)[0]
         from deeprest_tpu.serve.predictor import rolled_prediction_reference
 
@@ -430,6 +541,75 @@ class FusedInferenceMixin:
             delta_mask=self.delta_mask if integrate else None,
             median_index=self.median_index())
 
+    _warned_fat_rows = False
+
+    def _maybe_sparsify(self, series_list):
+        """Host-side dense→COO conversion for a sparse_feed backend: the
+        wire format is dense (HTTP JSON, featurized corpora), but when
+        the engine's sparse program is up the device should still get the
+        ~F/(2K)-smaller padded-COO pages — outputs are bit-identical
+        either way.  Returns None (caller ships dense) when the feature
+        is off or any row overflows the K cap; the overflow is warned
+        ONCE, not raised — an explicitly-sparse caller chose the format
+        and gets the loud error, a dense caller never handed us COO and
+        must not 500 because one bucket ran hot."""
+        if not (getattr(self, "sparse_feed", False)
+                and self._fused is not None
+                and self._fused.sparse_enabled):
+            return None
+        from deeprest_tpu.ops.densify import sparsify_rows
+
+        try:
+            return [sparsify_rows(s, self._fused._sparse_nnz_cap)[:2]
+                    for s in series_list]
+        except ValueError as exc:
+            if not FusedInferenceMixin._warned_fat_rows:
+                FusedInferenceMixin._warned_fat_rows = True
+                print(f"sparse-feed: dense fallback for a request "
+                      f"({exc}); raise --sparse-nnz-cap to keep the "
+                      "sparse feed (warned once)")
+            return None
+
+    def predict_series_sparse(self, cols: np.ndarray, vals: np.ndarray,
+                              integrate: bool = True) -> np.ndarray:
+        """Sparse-first twin of :meth:`predict_series`: ``(cols[T, K],
+        vals[T, K])`` padded-COO raw traffic → de-normalized ``[T, E, Q]``
+        predictions, bit-identical to the dense entry on the equivalent
+        series.
+
+        Routes through the fused engine's sparse program when the backend
+        was built with ``sparse_feed`` (the ~F/(2K) feed-byte path);
+        otherwise densifies ON HOST — bit-exact by construction — and
+        falls back to the dense entry, so sparse callers work against any
+        backend (e.g. exported artifacts, which bake a dense signature).
+        """
+        if (self._fused is not None and self._fused.sparse_enabled
+                and np.asarray(cols).shape[-1]
+                == self._fused._sparse_nnz_cap):
+            return self._fused.predict_many_sparse(
+                [(cols, vals)], integrate=integrate)[0]
+        from deeprest_tpu.ops.densify import densify_rows
+
+        return self.predict_series(
+            densify_rows(cols, vals, self.feature_dim),
+            integrate=integrate)
+
+    def predict_series_many_sparse(self, sparse_series_list,
+                                   integrate: bool = True
+                                   ) -> list[np.ndarray]:
+        """Batched sparse entry: S ``(cols[T_i, K], vals[T_i, K])`` pairs
+        fold into the fused engine's scenario×window axis exactly like
+        :meth:`predict_series_many` (shared pages, per-series carry
+        resets), shipped as COO."""
+        sparse_series_list = list(sparse_series_list)
+        if (self._fused is not None and self._fused.sparse_enabled
+                and all(np.shape(c)[-1] == self._fused._sparse_nnz_cap
+                        for c, _ in sparse_series_list)):
+            return self._fused.predict_many_sparse(sparse_series_list,
+                                                   integrate=integrate)
+        return [self.predict_series_sparse(c, v, integrate=integrate)
+                for c, v in sparse_series_list]
+
     def predict_series_many(self, series_list,
                             integrate: bool = True) -> list[np.ndarray]:
         """Batched multi-series entry: S raw ``[T_i, F]`` series fold into
@@ -438,7 +618,13 @@ class FusedInferenceMixin:
         ``WhatIfEstimator.estimate_many`` and capacity sweeps.  Falls back
         to per-series prediction when the fused engine is disabled."""
         if self._fused is not None:
-            return self._fused.predict_many(list(series_list),
+            series_list = list(series_list)
+            sparse = self._maybe_sparsify(
+                [np.asarray(s) for s in series_list])  # graftlint: disable=JX003 -- host data: wire-format series are numpy arrays/lists, asarray never touches a device buffer
+            if sparse is not None:
+                return self._fused.predict_many_sparse(sparse,
+                                                       integrate=integrate)
+            return self._fused.predict_many(series_list,
                                             integrate=integrate)
         return [self.predict_series(s, integrate=integrate)
                 for s in series_list]

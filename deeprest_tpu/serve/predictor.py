@@ -117,7 +117,9 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                  fused: bool = True,
                  page_windows: int | None = None,
                  coalesce_pages: int | None = None,
-                 coalesce_groups: int = 1):
+                 coalesce_groups: int = 1,
+                 sparse_feed: bool = False,
+                 sparse_nnz_cap: int = 64):
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -135,12 +137,41 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         self._apply = jax.jit(
             lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
         )
+        # Sparse-first serving feed (InferConfig.sparse_feed): a second
+        # jitted apply taking RAW padded-COO windows plus the staged
+        # stats — densify (one scatter-add) + normalize + model, all on
+        # device (ops/densify.py for the bit-parity contract; stats are
+        # runtime ARGUMENTS, like the fused engine's, so XLA cannot
+        # strength-reduce the divide).  Dense entries stay the default.
+        self.sparse_feed = bool(sparse_feed)
+        self.sparse_nnz_cap = int(sparse_nnz_cap)
+        apply_sparse = None
+        if self.sparse_feed:
+            from deeprest_tpu.ops.densify import (
+                densify_coo, normalize_minmax,
+            )
+
+            feat = model_config.feature_dim
+            x_mn = jnp.asarray(
+                np.asarray(x_stats.min, np.float32).reshape(-1))
+            x_rg = jnp.asarray(
+                np.asarray(x_stats.range, np.float32).reshape(-1))
+            self._apply_sparse = jax.jit(
+                lambda p, c, v, mn, rg: self.model.apply(
+                    {"params": p},
+                    normalize_minmax(densify_coo(c, v, feat), mn, rg),
+                    deterministic=True))
+            apply_sparse = lambda c, v: self._apply_sparse(
+                self.params, jnp.asarray(c), jnp.asarray(v), x_mn, x_rg)
+        else:
+            self._apply_sparse = None
         # All serving batches go through the shape ladder (and, when one
         # is attached, the cross-request MicroBatcher): the jit cache
         # holds one executable per rung, never one per ragged shape.
         self._init_batching(
             lambda x: self._apply(self.params, jnp.asarray(x)),
-            ladder=ladder, coalesce_groups=coalesce_groups)
+            ladder=ladder, coalesce_groups=coalesce_groups,
+            apply_sparse_fn=apply_sparse)
         # The fused device-resident rolled-inference engine (serve/fused.py)
         # shares the ladder's rung set, so mixed series lengths compile at
         # most one fused executable per rung.  Params thread through the
@@ -148,7 +179,9 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         self._init_fused(
             lambda p, x: self._apply(p, x), params=self.params,
             enabled=fused, page_windows=page_windows,
-            coalesce_pages=coalesce_pages)
+            coalesce_pages=coalesce_pages,
+            sparse_nnz_cap=(self.sparse_nnz_cap if self.sparse_feed
+                            else None))
 
     def jit_cache_size(self) -> int | None:
         """Total compiled-executable count across BOTH serving programs —
@@ -157,9 +190,11 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         hook behind the 'mixed series lengths trigger zero new compiles'
         guarantee.  ``jit_cache_stats`` has the per-program breakdown."""
         sizes = []
-        probe = getattr(self._apply, "_cache_size", None)
-        if callable(probe):
-            sizes.append(int(probe()))
+        for fn in (self._apply, self._apply_sparse):
+            probe = getattr(fn, "_cache_size", None) if fn is not None \
+                else None
+            if callable(probe):
+                sizes.append(int(probe()))
         if self._fused is not None:
             fused = self._fused.cache_size()
             if fused is not None:
@@ -169,8 +204,11 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
     def jit_cache_stats(self) -> dict:
         """Per-program executable counts plus the rung sets bounding them."""
         probe = getattr(self._apply, "_cache_size", None)
+        sprobe = getattr(self._apply_sparse, "_cache_size", None) \
+            if self._apply_sparse is not None else None
         return {
             "apply": int(probe()) if callable(probe) else None,
+            "apply_sparse": int(sprobe()) if callable(sprobe) else None,
             "fused": (self._fused.cache_size()
                       if self._fused is not None else None),
             "ladder_rungs": len(self.ladder.ladder),
@@ -209,6 +247,8 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                         page_windows: int | None = None,
                         coalesce_pages: int | None = None,
                         coalesce_groups: int = 1,
+                        sparse_feed: bool = False,
+                        sparse_nnz_cap: int = 64,
                         mesh_config=None) -> "Predictor":
         """Restore params + host stats written by Trainer.save().
 
@@ -239,14 +279,16 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             sp.tag(directory=directory, step=step)
             return cls._from_checkpoint_inner(
                 directory, config, step, ladder, fused, page_windows,
-                coalesce_pages, coalesce_groups, mesh_config,
+                coalesce_pages, coalesce_groups, sparse_feed,
+                sparse_nnz_cap, mesh_config,
                 make_mesh, latest_step, load_sidecar, restore_checkpoint,
                 Trainer)
 
     @classmethod
     def _from_checkpoint_inner(cls, directory, config, step, ladder, fused,
                                page_windows, coalesce_pages,
-                               coalesce_groups, mesh_config, make_mesh,
+                               coalesce_groups, sparse_feed,
+                               sparse_nnz_cap, mesh_config, make_mesh,
                                latest_step, load_sidecar,
                                restore_checkpoint, Trainer) -> "Predictor":
         if step is None:
@@ -287,6 +329,8 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             page_windows=page_windows,
             coalesce_pages=coalesce_pages,
             coalesce_groups=coalesce_groups,
+            sparse_feed=sparse_feed,
+            sparse_nnz_cap=sparse_nnz_cap,
         )
 
     def space(self):

@@ -80,6 +80,8 @@ class CheckpointReloader:
                  fused: bool = True, page_windows: int | None = None,
                  coalesce_pages: int | None = None,
                  coalesce_groups: int = 1,
+                 sparse_feed: bool = False,
+                 sparse_nnz_cap: int = 64,
                  mesh_config=None):
         from deeprest_tpu.train.checkpoint import latest_step
 
@@ -90,6 +92,8 @@ class CheckpointReloader:
         self.page_windows = page_windows
         self.coalesce_pages = coalesce_pages
         self.coalesce_groups = coalesce_groups
+        self.sparse_feed = sparse_feed   # ... and the sparse-feed plane
+        self.sparse_nnz_cap = sparse_nnz_cap
         self.mesh_config = mesh_config   # ... and the serving mesh (TP)
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
@@ -138,6 +142,8 @@ class CheckpointReloader:
                 fused=self.fused, page_windows=self.page_windows,
                 coalesce_pages=self.coalesce_pages,
                 coalesce_groups=self.coalesce_groups,
+                sparse_feed=self.sparse_feed,
+                sparse_nnz_cap=self.sparse_nnz_cap,
                 mesh_config=self.mesh_config)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/

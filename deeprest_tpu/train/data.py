@@ -90,6 +90,94 @@ class SeriesRing:
         self._len = 0
 
 
+class SparseSeriesRing:
+    """Bounded padded-COO row history: the sparse-first twin of
+    :class:`SeriesRing` for the traffic half of the streaming corpus.
+
+    Each retained row is ``(cols[K], vals[K], nnz)`` — the
+    ``CallPathSpace.extract_sparse`` output padded to the fixed
+    ``nnz_cap`` with ``(0, 0.0)`` entries — instead of a dense
+    ``[capacity]`` float32 vector.  At F=10240, K=64 the resident bytes
+    drop ~F/(2K) (int32 cols + float32 vals vs dense float32): a
+    month-scale retained corpus goes from ~3.5 GB of ring to ~44 MB.
+
+    Storage is three lock-stepped :class:`SeriesRing` buffers so the
+    wrap/eviction/zero-copy-view semantics (and their tests) are shared,
+    not re-implemented; ``view()`` returns the same oldest-first
+    contiguous views, valid until ~maxlen further appends.
+
+    A row with more than ``nnz_cap`` nonzero columns RAISES — the
+    documented K-cap policy (silently dropping call paths would corrupt
+    the count vector; size ``--sparse-nnz-cap`` to the corpus instead).
+    """
+
+    def __init__(self, maxlen: int, capacity: int, nnz_cap: int):
+        if nnz_cap < 1:
+            raise ValueError(f"nnz_cap must be >= 1, got {nnz_cap}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.nnz_cap = int(nnz_cap)
+        self._cols = SeriesRing(maxlen, nnz_cap, np.int32)
+        self._vals = SeriesRing(maxlen, nnz_cap, np.float32)
+        self._nnz = SeriesRing(maxlen, 1, np.int32)
+
+    def __len__(self) -> int:
+        return len(self._cols)
+
+    @property
+    def maxlen(self) -> int:
+        return self._cols.maxlen
+
+    @property
+    def nbytes(self) -> int:
+        """Resident buffer bytes (the memory-ceiling number
+        benchmarks/tenk_bench.py banks)."""
+        return (self._cols._buf.nbytes + self._vals._buf.nbytes
+                + self._nnz._buf.nbytes)
+
+    def append_sparse(self, cols: np.ndarray, vals: np.ndarray) -> None:
+        """Append one ``(cols, vals)`` sparse row (unpadded, as
+        ``extract_sparse`` returns it)."""
+        n = len(cols)
+        if n != len(vals):
+            raise ValueError(f"cols/vals length mismatch: {n} vs {len(vals)}")
+        if n > self.nnz_cap:
+            raise ValueError(
+                f"sparse traffic row has {n} nonzero columns, over the "
+                f"nnz cap {self.nnz_cap}; raise --sparse-nnz-cap (or "
+                f"disable --sparse-feed) — silently dropping call paths "
+                f"would corrupt the count vector")
+        cslot = self._cols.append_slot()
+        cslot[:n] = cols
+        cslot[n:] = 0
+        vslot = self._vals.append_slot()
+        vslot[:n] = vals
+        vslot[n:] = 0.0
+        self._nnz.append_slot()[0] = n
+
+    def view(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Zero-copy ``(cols[T, K], vals[T, K], nnz[T])`` of the retained
+        history, oldest first (SeriesRing.view validity contract)."""
+        return self._cols.view(), self._vals.view(), self._nnz.view()[:, 0]
+
+    def densify(self) -> np.ndarray:
+        """Dense ``[T, capacity]`` reconstruction — the parity reference
+        (bit-identical to a SeriesRing fed from ``extract``) and the
+        escape hatch for dense-only consumers.  Materializes the full
+        matrix: never call this on the 10k-wide hot path (graftlint
+        DN001 guards the watchlisted modules)."""
+        from deeprest_tpu.ops.densify import densify_rows
+
+        cols, vals, _ = self.view()
+        return densify_rows(cols, vals, self.capacity)
+
+    def clear(self) -> None:
+        self._cols.clear()
+        self._vals.clear()
+        self._nnz.clear()
+
+
 def delta_mask(metric_names: Sequence[str],
                resources: Sequence[str]) -> np.ndarray:
     """Boolean [E] mask of metrics (named ``component_resource``) whose
@@ -138,9 +226,12 @@ def integrate_level_columns(preds: np.ndarray, mask: np.ndarray,
 class DatasetBundle:
     """Normalized windows plus everything needed to de-normalize and compare."""
 
-    x_train: np.ndarray        # [N_train, W, F] normalized traffic windows
+    # Dense traffic windows are None for sparse-first bundles (the 10k-
+    # endpoint streaming path never materializes [N, W, F]); consumers go
+    # through num_train_windows/num_test_windows and the staged feed.
+    x_train: np.ndarray | None  # [N_train, W, F] normalized traffic windows
     y_train: np.ndarray        # [N_train, W, E] normalized targets
-    x_test: np.ndarray         # [N_test, W, F]
+    x_test: np.ndarray | None  # [N_test, W, F]
     y_test: np.ndarray         # [N_test, W, E]
     x_stats: MinMaxStats
     y_stats: MinMaxStats       # per-metric (broadcast shape [1, E])
@@ -164,14 +255,40 @@ class DatasetBundle:
     # re-sends the same bytes W times (the 10k-wide host-feed wall).
     x_base: np.ndarray | None = None
     y_base: np.ndarray | None = None
+    # Sparse-first traffic (padded-COO): RAW (un-normalized) [T, K] rows
+    # + [T] row lengths, the 10k-endpoint alternative to x_base.  The
+    # staged feed densifies + normalizes ON DEVICE (ops/densify.py)
+    # inside the existing train/eval executables; host→device bytes
+    # drop ~F/(2K).  When set, x_train/x_test may be None — the windows
+    # were never materialized — and n_train/n_test carry the counts.
+    x_cols: np.ndarray | None = None       # [T, K] int32
+    x_vals: np.ndarray | None = None       # [T, K] float32 raw counts
+    x_nnz: np.ndarray | None = None        # [T] int32 row lengths
+    sparse_capacity: int | None = None     # dense width F of the COO rows
+    n_train: int | None = None             # window counts for sparse bundles
+    n_test: int | None = None
 
     @property
     def num_metrics(self) -> int:
         return len(self.metric_names)
 
     @property
+    def is_sparse(self) -> bool:
+        return self.x_cols is not None
+
+    @property
+    def num_train_windows(self) -> int:
+        return self.n_train if self.n_train is not None else len(self.x_train)
+
+    @property
+    def num_test_windows(self) -> int:
+        return self.n_test if self.n_test is not None else len(self.x_test)
+
+    @property
     def feature_dim(self) -> int:
-        return self.x_train.shape[-1]
+        if self.x_train is not None:
+            return self.x_train.shape[-1]
+        return int(self.sparse_capacity)
 
     def denorm_targets(self, y: np.ndarray) -> np.ndarray:
         return self.y_stats.invert(y)
@@ -255,6 +372,19 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
     x = sliding_windows(x_n, w)                   # [N, W, F] view
     y = sliding_windows(y_n, w)                   # [N, W, E] view
 
+    # Sparse-first feed (config.sparse_feed): carry the RAW traffic as
+    # padded-COO rows alongside the dense views (the offline corpus is
+    # already dense in host memory; what the sparse form saves here is
+    # the host→device feed bytes — the trainer stages cols/vals instead
+    # of x_base and densifies on device).  Overflowing the K cap raises
+    # loudly (ops/densify.sparsify_rows).
+    x_cols = x_vals = x_nnz = None
+    if getattr(config, "sparse_feed", False):
+        from deeprest_tpu.ops.densify import sparsify_rows
+
+        x_cols, x_vals, x_nnz = sparsify_rows(traffic,
+                                              config.sparse_nnz_cap)
+
     return DatasetBundle(
         x_train=x[:split],
         y_train=y[:split],
@@ -270,6 +400,10 @@ def prepare_dataset(data: FeaturizedData, config: TrainConfig) -> DatasetBundle:
         raw_targets=raw_targets,
         x_base=x_n,
         y_base=y_n,
+        x_cols=x_cols,
+        x_vals=x_vals,
+        x_nnz=x_nnz,
+        sparse_capacity=(traffic.shape[-1] if x_cols is not None else None),
     )
 
 

@@ -76,8 +76,9 @@ from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.data.schema import Bucket
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
+from deeprest_tpu.ops.densify import sparse_minmax
 from deeprest_tpu.train.data import (
-    DatasetBundle, SeriesRing, delta_mask, to_increments,
+    DatasetBundle, SeriesRing, SparseSeriesRing, delta_mask, to_increments,
 )
 from deeprest_tpu.train.trainer import Trainer, TrainState
 
@@ -367,7 +368,24 @@ class StreamingTrainer:
         # straight into the traffic ring's next slot (zero allocation on
         # the poll/ETL path) and refresh() windows the zero-copy contiguous
         # views in O(1) instead of re-stacking O(history) rows.
-        self.traffic = SeriesRing(stream.history_max, self.space.capacity)
+        #
+        # Sparse-first mode (TrainConfig.sparse_feed — the 10k-endpoint
+        # tier): the traffic half is a padded-COO SparseSeriesRing
+        # instead, ingested via extract_sparse and fed to the device as
+        # (cols, vals) with a single on-device densify inside the train
+        # executables; no dense [T, F] (or [N, W, F]) traffic tensor ever
+        # materializes on this path — ~F/(2K) less ring memory AND feed
+        # bytes at F=10240, with refresh losses bit-identical to the
+        # dense reference (tests/test_sparse.py).  Targets stay dense
+        # (E is small).
+        self.sparse = bool(config.train.sparse_feed)
+        if self.sparse:
+            self.traffic = SparseSeriesRing(
+                stream.history_max, self.space.capacity,
+                config.train.sparse_nnz_cap)
+        else:
+            self.traffic = SeriesRing(stream.history_max,
+                                      self.space.capacity)
         self.metrics: deque[dict[str, float]] = deque(maxlen=stream.history_max)
         # Targets ring mirrors the metrics deque as float32 rows once the
         # metric set freezes (same [t, i] = v writes _targets() used to do
@@ -396,22 +414,33 @@ class StreamingTrainer:
     # -- ingestion ------------------------------------------------------
 
     def ingest(self, bucket: Bucket) -> None:
-        # extract(out=...) fills the ring's next slot in place: no fresh
-        # [capacity] float32 per bucket on the poll thread.
-        self.space.extract(bucket.traces, out=self.traffic.append_slot())
+        if self.sparse:
+            # The sparse ingest never touches a [capacity]-wide buffer:
+            # extract_sparse returns the bucket's (cols, counts) pair and
+            # the ring stores it padded to the K cap.
+            self.traffic.append_sparse(*self.space.extract_sparse(
+                bucket.traces))
+        else:
+            # extract(out=...) fills the ring's next slot in place: no
+            # fresh [capacity] float32 per bucket on the poll thread.
+            self.space.extract(bucket.traces, out=self.traffic.append_slot())
         self._commit_metrics({m.key: m.value for m in bucket.metrics})
 
-    def _featurize(self, bucket: Bucket) -> tuple[np.ndarray, dict[str, float]]:
+    def _featurize(self, bucket: Bucket) -> tuple:
         """Featurize off the train thread (overlap mode): the returned row
-        is owned by the caller and committed later via _ingest_featurized,
+        (dense [capacity] vector, or a sparse ``(cols, vals)`` pair) is
+        owned by the caller and committed later via _ingest_featurized,
         so the shared rings are only ever touched by the train thread."""
-        return (self.space.extract(bucket.traces),
-                {m.key: m.value for m in bucket.metrics})
+        row = (self.space.extract_sparse(bucket.traces) if self.sparse
+               else self.space.extract(bucket.traces))
+        return (row, {m.key: m.value for m in bucket.metrics})
 
-    def _ingest_featurized(
-            self, feat: tuple[np.ndarray, dict[str, float]]) -> None:
+    def _ingest_featurized(self, feat: tuple) -> None:
         row, metrics_row = feat
-        self.traffic.append_slot()[:] = row
+        if self.sparse:
+            self.traffic.append_sparse(*row)
+        else:
+            self.traffic.append_slot()[:] = row
         self._commit_metrics(metrics_row)
 
     def _commit_metrics(self, row: dict[str, float]) -> None:
@@ -492,7 +521,6 @@ class StreamingTrainer:
         # rebuild were O(history).  Both views are consumed (normalized or
         # windowed into device arrays) before refresh returns, within the
         # rings' validity window.
-        traffic = self.traffic.view()
         raw_targets = self._targets()
         # Level-type resources train as per-bucket increments (the same
         # transform prepare_dataset applies — train/data.py).  Recomputed
@@ -510,16 +538,35 @@ class StreamingTrainer:
             dmask = self._resumed_delta_mask
         targets = to_increments(raw_targets, dmask)
 
-        x = sliding_windows(traffic, w)
+        if self.sparse:
+            # Sparse-first: no dense traffic tensor, windowed or
+            # otherwise, ever materializes here.  Window counts follow
+            # sliding_windows semantics (N = T - w) and the per-feature
+            # stats come from the padded-COO rows directly —
+            # sparse_minmax is bit-identical to minmax_fit over the
+            # equivalent dense train-span windows (the span rows
+            # [0, split + w - 1) ARE the train windows' union, the same
+            # equivalence prepare_dataset relies on).
+            cols_v, vals_v, nnz_v = self.traffic.view()
+            n_windows = len(self.traffic) - w
+            x = None
+        else:
+            traffic = self.traffic.view()
+            x = sliding_windows(traffic, w)
+            n_windows = len(x)
         y = sliding_windows(targets, w)
-        holdout = min(self.stream.eval_holdout, len(x) - 1)
-        split = len(x) - holdout
+        holdout = min(self.stream.eval_holdout, n_windows - 1)
+        split = n_windows - holdout
 
         # Expanding stats: union with every past refresh (monotone), fit
         # per column — traffic per feature, targets per metric (module
         # docstring: "Per-feature traffic stats").
-        self.x_union = expand_minmax(self.x_union,
-                                     minmax_fit(x, split, axis=(0, 1)))
+        if self.sparse:
+            new_x_stats = sparse_minmax(cols_v, vals_v, nnz_v,
+                                        split + w - 1, self.space.capacity)
+        else:
+            new_x_stats = minmax_fit(x, split, axis=(0, 1))
+        self.x_union = expand_minmax(self.x_union, new_x_stats)
         self.y_stats = expand_minmax(self.y_stats,
                                      minmax_fit(y, split, axis=(0, 1)))
         # Effective traffic stats: degenerate columns would pass serve-time
@@ -537,18 +584,36 @@ class StreamingTrainer:
                          np.where(union.max > 0, union.max, glob),
                          union.max).astype(np.float32))
 
-        x_n = self.x_stats.apply(x).astype(np.float32)
         y_n = self.y_stats.apply(y).astype(np.float32)
-        bundle = DatasetBundle(
-            x_train=x_n[:split], y_train=y_n[:split],
-            x_test=x_n[split:], y_test=y_n[split:],
-            x_stats=self.x_stats, y_stats=self.y_stats,
-            metric_names=self._freeze_metrics(), split=split,
-            window_size=w, space_dict=self.space.to_dict(),
-            delta_mask=dmask, raw_targets=raw_targets,
-            x_base=self.x_stats.apply(traffic).astype(np.float32),
-            y_base=self.y_stats.apply(targets).astype(np.float32),
-        )
+        if self.sparse:
+            # RAW cols/vals ride in the bundle (zero-copy ring views,
+            # consumed by stage_dataset before refresh returns);
+            # normalization happens on device with the staged stats.
+            bundle = DatasetBundle(
+                x_train=None, y_train=y_n[:split],
+                x_test=None, y_test=y_n[split:],
+                x_stats=self.x_stats, y_stats=self.y_stats,
+                metric_names=self._freeze_metrics(), split=split,
+                window_size=w, space_dict=self.space.to_dict(),
+                delta_mask=dmask, raw_targets=raw_targets,
+                x_base=None,
+                y_base=self.y_stats.apply(targets).astype(np.float32),
+                x_cols=cols_v, x_vals=vals_v, x_nnz=nnz_v,
+                sparse_capacity=self.space.capacity,
+                n_train=split, n_test=n_windows - split,
+            )
+        else:
+            x_n = self.x_stats.apply(x).astype(np.float32)
+            bundle = DatasetBundle(
+                x_train=x_n[:split], y_train=y_n[:split],
+                x_test=x_n[split:], y_test=y_n[split:],
+                x_stats=self.x_stats, y_stats=self.y_stats,
+                metric_names=self._freeze_metrics(), split=split,
+                window_size=w, space_dict=self.space.to_dict(),
+                delta_mask=dmask, raw_targets=raw_targets,
+                x_base=self.x_stats.apply(traffic).astype(np.float32),
+                y_base=self.y_stats.apply(targets).astype(np.float32),
+            )
 
         if self.trainer is None:
             model = dataclasses.replace(
@@ -558,7 +623,8 @@ class StreamingTrainer:
             self.trainer = Trainer(self.config, self.space.capacity,
                                    bundle.metric_names)
         if self.state is None:
-            self.state = self.trainer.init_state(bundle.x_train)
+            self.state = self.trainer.init_state(
+                self.trainer.sample_input(bundle))
 
         data_rng = np.random.default_rng(
             self.config.train.seed + self._refresh_count)
@@ -650,7 +716,7 @@ class StreamingTrainer:
             num_metrics=len(self.metric_names))
         self.config = dataclasses.replace(self.config, model=model)
         self.trainer = Trainer(self.config, feature_dim, self.metric_names)
-        target = self.trainer.init_state(np.zeros(
+        target = self.trainer.init_state(np.zeros(  # graftlint: disable=DN001 -- one [1, W, F] init SAMPLE (shape donor for param init), not a corpus-scale materialization
             (1, self.config.train.window_size, feature_dim), np.float32))
         self.state, _ = restore_checkpoint(self.ckpt_dir, target, step=step)
         try:

@@ -28,9 +28,11 @@ import optax
 from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU, fold_feature_mask
 from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.ops.densify import SparseBase, gather_densify_normalize
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
     feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
+    stage_sparse_base,
 )
 from deeprest_tpu.parallel.mesh import make_mesh
 from deeprest_tpu.parallel.sharding import shard_params, state_sharding
@@ -118,6 +120,18 @@ class Trainer:
                 loss,
             )
 
+        def gather_x(x_base, idx):
+            # The one place the staged feed's two forms meet: a dense
+            # normalized [T, F] base gathers directly; a SparseBase
+            # (padded-COO cols/vals + staged stats) gathers [.., W, K]
+            # rows, densifies via one scatter-add, and normalizes ON
+            # DEVICE — all inside the caller's existing jit, so the
+            # sparse feed adds no executables beyond the per-form
+            # signature (ops/densify.py for the numerics contract).
+            if isinstance(x_base, SparseBase):
+                return gather_densify_normalize(x_base, idx)
+            return x_base[idx]
+
         def train_step_indexed(state: TrainState, x_base, y_base, starts, wb):
             # Device-resident feed: the normalized BASE series live in HBM
             # (stage_dataset) and each step gathers its windows by start
@@ -127,7 +141,7 @@ class Trainer:
             # at F=10240 over the tunneled chip that was a 200× feed gap).
             w = self.config.train.window_size
             idx = starts[:, None] + jnp.arange(w)[None, :]    # [B, W]
-            return train_step(state, x_base[idx], y_base[idx], wb)
+            return train_step(state, gather_x(x_base, idx), y_base[idx], wb)
 
         def train_superstep(state: TrainState, x_base, y_base,
                             starts_plan, weights_plan, chunk):
@@ -212,7 +226,7 @@ class Trainer:
         def _gather_windows(x_base, y_base, starts):
             w = self.config.train.window_size
             idx = starts[:, None] + jnp.arange(w)[None, :]    # [B, W]
-            return x_base[idx], y_base[idx]
+            return gather_x(x_base, idx), y_base[idx]
 
         def _accum_grads_exact(params, x_base, y_base, starts, wb, step_key):
             folded, fold_vjp = jax.vjp(fold_feature_mask, params)
@@ -334,7 +348,7 @@ class Trainer:
         def eval_step_indexed(params, x_base, y_base, starts):
             w = self.config.train.window_size
             idx = starts[:, None] + jnp.arange(w)[None, :]    # [n, W]
-            return eval_step(params, x_base[idx], y_base[idx])
+            return eval_step(params, gather_x(x_base, idx), y_base[idx])
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._train_step_indexed = jax.jit(train_step_indexed, donate_argnums=0)
@@ -385,6 +399,16 @@ class Trainer:
             self._m_executables.set(cache)
 
     # ------------------------------------------------------------------
+
+    def sample_input(self, bundle: DatasetBundle) -> np.ndarray:
+        """A ``[1, W, F]`` init sample for ``init_state``.  Flax parameter
+        initialization depends on shapes and the init rng, never on the
+        sample's values, so sparse bundles (no dense windows) use zeros —
+        identical params to a dense-bundle init of the same shape."""
+        if bundle.x_train is not None:
+            return bundle.x_train[:1]
+        return np.zeros((1, bundle.window_size, bundle.feature_dim),
+                        np.float32)
 
     def init_state(self, sample_x: np.ndarray, seed: int | None = None) -> TrainState:
         """Initialize (and shard) params + optimizer state."""
@@ -496,6 +520,16 @@ class Trainer:
                 f"TrainConfig.device_data={cfg.device_data!r}: must be "
                 f"'auto', 'always', or 'off' (an unknown value silently "
                 f"skipping the byte budget could OOM the chip)")
+        if cfg.sparse_feed and bundle.is_sparse:
+            return self._stage_sparse(bundle)
+        if bundle.x_base is None and bundle.is_sparse:
+            # A sparse-only bundle (streaming 10k tier) has no dense base
+            # or windows to fall back to; reaching here means sparse_feed
+            # was turned off against a sparse corpus.
+            raise ValueError(
+                "bundle carries only sparse (padded-COO) traffic but "
+                "TrainConfig.sparse_feed is off; enable sparse_feed or "
+                "rebuild the bundle with dense traffic")
         if (cfg.device_data == "off" or bundle.x_base is None
                 or bundle.y_base is None):
             return None
@@ -520,10 +554,50 @@ class Trainer:
         return (feed_replicated(self.mesh, x),
                 feed_replicated(self.mesh, np.asarray(bundle.y_base)))
 
+    def _stage_sparse(self, bundle: DatasetBundle):
+        """Stage the padded-COO traffic base + its normalization stats.
+
+        The sparse twin of the dense staging: RAW ``cols``/``vals`` rows
+        ship once (~F/(2K) fewer bytes than the dense base at 10k width)
+        and every step's gather densifies + normalizes on device
+        (ops/densify.py — stats ride as runtime arguments so XLA cannot
+        strength-reduce the divide; bit parity with the host-normalized
+        dense path is pinned by tests/test_sparse.py).  Unlike the dense
+        "auto" rule this stages on the CPU backend too: the sparse feed
+        IS the staged feed — there is no host-windowed fallback to
+        prefer."""
+        cfg = self.config.train
+        if bundle.y_base is None:
+            raise ValueError("sparse bundle lacks y_base; the targets "
+                             "stay dense and must be stageable")
+        total = (bundle.x_cols.nbytes + bundle.x_vals.nbytes
+                 + bundle.y_base.nbytes)
+        if cfg.device_data == "auto" and total > cfg.device_data_max_bytes:
+            raise ValueError(
+                f"sparse base ({total} bytes) exceeds "
+                f"device_data_max_bytes ({cfg.device_data_max_bytes}); "
+                "there is no host-feed fallback for the sparse form — "
+                "raise the budget or shrink history_max/nnz_cap")
+        x_stats = bundle.x_stats
+        mn = np.asarray(x_stats.min, np.float32).reshape(-1)
+        rg = np.asarray(x_stats.range, np.float32).reshape(-1)
+        base = stage_sparse_base(
+            self.mesh,
+            np.ascontiguousarray(bundle.x_cols, dtype=np.int32),
+            np.ascontiguousarray(bundle.x_vals, dtype=np.float32),
+            mn, rg, int(bundle.sparse_capacity or bundle.feature_dim))
+        return base, feed_replicated(self.mesh, np.asarray(bundle.y_base))
+
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
                     epoch_rng: np.random.Generator,
                     staged=None) -> tuple[TrainState, float]:
         accum = self.config.train.grad_accum_windows
+        if staged is None and bundle.is_sparse:
+            raise ValueError(
+                "sparse (padded-COO) bundles train only through the "
+                "staged device-resident feed — the on-device densify "
+                "lives inside the staged executables; call "
+                "stage_dataset(bundle) with TrainConfig.sparse_feed=True")
         if staged is None and accum > 1:
             raise ValueError(
                 f"grad_accum_windows={accum} requires the staged "
@@ -532,7 +606,8 @@ class Trainer:
                 "dataset (device_data='always' forces it on the CPU "
                 "backend) or set grad_accum_windows=1")
         if staged is not None:
-            num_steps = -(-len(bundle.x_train) // self.config.train.batch_size)
+            num_steps = -(-bundle.num_train_windows
+                          // self.config.train.batch_size)
             s = self._superstep_len(num_steps)
             if s > 1:
                 return self._train_epoch_superstep(state, bundle, epoch_rng,
@@ -549,7 +624,7 @@ class Trainer:
                 # one host; on a pod, each process ships only its
                 # process_batch_slice of the (identical, rng-deterministic)
                 # global selection.
-                for sel, weight in self._batches(len(bundle.x_train),
+                for sel, weight in self._batches(bundle.num_train_windows,
                                                  epoch_rng):
                     yield bundle.x_train[sel], bundle.y_train[sel], weight
 
@@ -567,7 +642,7 @@ class Trainer:
                 # keeps the [B] start/weight copies of step t+1 in flight
                 # behind the step on batch t — the superstep-disabled
                 # fallback overlaps transfer with compute too.
-                for sel, weight in self._batches(len(bundle.x_train),
+                for sel, weight in self._batches(bundle.num_train_windows,
                                                  epoch_rng):
                     yield sel.astype(np.int32), weight
 
@@ -622,7 +697,7 @@ class Trainer:
         log_every = cfg.log_every_steps
         x_base, y_base = staged
         starts, weights, num_steps = self._epoch_plan(
-            len(bundle.x_train), epoch_rng, s)
+            bundle.num_train_windows, epoch_rng, s)
         starts_d, weights_d = stage_plan(self.mesh, starts, weights)
         # The coalesced (grad-accum) superstep and the per-step superstep
         # share the whole driver: only the compiled scan differs.
@@ -686,7 +761,11 @@ class Trainer:
         base row ``split + i`` — shipping only start indices per chunk.
         """
         cfg = self.config.train
-        idx = eval_window_indices(len(bundle.x_test), cfg.eval_stride,
+        if staged is None and bundle.is_sparse:
+            raise ValueError(
+                "sparse (padded-COO) bundles evaluate only through the "
+                "staged device-resident feed (see train_epoch)")
+        idx = eval_window_indices(bundle.num_test_windows, cfg.eval_stride,
                                   cfg.eval_max_cycles)
         if len(idx) == 0:
             raise ValueError("no eval windows: test split shorter than stride")
@@ -761,7 +840,7 @@ class Trainer:
     ) -> tuple[TrainState, list[EpochResult]]:
         cfg = self.config.train
         if state is None:
-            state = self.init_state(bundle.x_train)
+            state = self.init_state(self.sample_input(bundle))
         data_rng = np.random.default_rng(cfg.seed)
         history: list[EpochResult] = []
         total = num_epochs if num_epochs is not None else cfg.num_epochs

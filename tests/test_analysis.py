@@ -803,6 +803,58 @@ def measure():
     assert findings_for("OB001", src, rel="serve/hot.py")
 
 
+# ---------------------------------------------------------------------------
+# DN001: dense traffic materialization in sparse-first hot modules
+
+
+DN001_BAD = """
+import numpy as np
+
+def refresh(self):
+    x = np.zeros((len(self.metrics), self.space.capacity), np.float32)
+    return x
+"""
+
+DN001_GOOD = """
+import numpy as np
+
+def refresh(self):
+    cols, vals, nnz = self.traffic.view()
+    return cols, vals, nnz
+"""
+
+
+def test_dn001_pair():
+    assert_pair("DN001", DN001_BAD, DN001_GOOD, rel="train/stream.py")
+    assert_pair("DN001", DN001_BAD, DN001_GOOD, rel="data/featurize.py")
+
+
+def test_dn001_leading_axis_and_literals_are_silent():
+    # a capacity-sized LEADING axis (e.g. a per-column stats vector of
+    # small width) and literal shapes are not the F-wide materialization
+    src = """
+import numpy as np
+
+def stats(self):
+    counts = np.zeros((self.capacity,), np.int64)[:, None] * 0
+    small = np.zeros((self.space.capacity, 4), np.float32)
+    fixed = np.zeros((1024, 64), np.float32)
+    return counts, small, fixed
+"""
+    fired = findings_for("DN001", src, rel="train/stream.py")
+    # only the bare (self.capacity,) single-axis alloc fires (its last
+    # axis IS the width); the (capacity, 4) and literal shapes stay silent
+    assert len(fired) == 1
+
+
+def test_dn001_non_watchlist_modules_are_silent():
+    # the dense offline path (train/data.py prepare_dataset) and serving
+    # are out of scope by design — only the converted hot modules are
+    # watched
+    assert not findings_for("DN001", DN001_BAD, rel="train/data.py")
+    assert not findings_for("DN001", DN001_BAD, rel="serve/fused.py")
+
+
 def test_hy001_unused_import_pair():
     bad = "import os\nimport sys\n\nprint(sys.argv)\n"
     good = "import sys\n\nprint(sys.argv)\n"
@@ -964,6 +1016,6 @@ def test_rule_registry_complete():
     rules = all_rules()
     assert {"JX001", "JX002", "JX003", "JX004",
             "TH001", "TH002", "TH003", "TH004",
-            "HY001", "HY002", "OB001"} <= set(rules)
+            "HY001", "HY002", "OB001", "DN001"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards

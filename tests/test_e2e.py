@@ -85,12 +85,27 @@ def test_end_to_end_slice(tmp_path):
             assert np.isfinite(report[name][method]["median"])
 
     # The model sees traffic; on this traffic-driven corpus it should beat
-    # the history-only baseline on at least one metric median after training.
-    beats = [
-        report[m]["deepr"]["median"] < report[m]["resrc"]["median"]
+    # the history-only baseline on at least one metric median after
+    # training.  ROADMAP has called this the flakiest assertion in the
+    # tree, so the margin is restated against the fully SEEDED run (rng
+    # pinned end to end through TrainConfig.seed=0: corpus seed=5, init/
+    # dropout/shuffle all derive from the config seed) rather than a bare
+    # "<" that any last-bit drift can flip.  Measured envelope at this
+    # seed (2026-08-05, 15 epochs): best ratio deepr/resrc = 0.748 on
+    # gateway_cpu (store-db_wiops honestly loses at 1.18 — wiops is
+    # bursty).  The assertion requires a ≥10% margin: 2.5× the distance
+    # any observed cross-platform numeric drift (BLAS kernel choice, XLA
+    # fusion order — the round-8 flake class) has ever moved this ratio,
+    # while a real regression (model stops learning traffic) lands near
+    # or above 1.0 and still fails crisply.
+    ratios = [
+        report[m]["deepr"]["median"] / report[m]["resrc"]["median"]
         for m in bundle.metric_names
     ]
-    assert any(beats), f"model never beats history baseline:\n{text}"
+    assert min(ratios) < 0.90, (
+        f"model's best margin over the history baseline collapsed "
+        f"(best deepr/resrc ratio {min(ratios):.3f}, seeded envelope "
+        f"0.748):\n{text}")
 
     # 5. checkpoint → restore → identical predictions
     save_checkpoint(str(tmp_path), state, int(state.step),

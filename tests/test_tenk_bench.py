@@ -1,0 +1,77 @@
+"""Tier-1 smoke for the 10k-endpoint vertical bench: `tenk_bench.py
+--quick` must run end to end on every suite pass (featurize + ring +
+byte-table + RSS plumbing), and the committed full-mode record must keep
+the acceptance numbers the round-15 PR banked — the >=20x sparse feed-byte
+cut at F=10240 and a documented month-scale peak RSS."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "tenk_bench.py")
+COMMITTED = os.path.join(REPO, "benchmarks", "tenk_bench.json")
+
+
+def test_quick_mode_emits_sound_json(tmp_path):
+    out = tmp_path / "tenk_bench.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.load(open(out)) == result
+    assert result["schema_version"] == 1
+    assert result["quick"] is True
+    feat = result["featurize"]
+    assert feat["capacity"] == 10240
+    assert feat["sparse_rows_per_sec"] > 0
+    # the sparse extraction must not be meaningfully slower than dense
+    # (it shares the memoized walk; only the tail differs) — generous
+    # bound for a noisy shared-CI host
+    assert feat["speedup"] > 0.5
+    ring = result["ring_ingest"]
+    assert ring["ring_bytes_ratio"] >= 20
+    fb = result["feed_bytes"]
+    assert fb["dense_bytes_per_window"] // fb[
+        "sparse_feed_bytes_per_window"] >= 20
+    assert result["tenk_peak_rss_mb"] > 0
+
+
+def test_committed_record_pins_acceptance_numbers():
+    """The committed full-mode artifact is the PR's acceptance evidence:
+    >=20x host->device byte cut per window at F=10240 and the month-scale
+    RSS ceiling documented (honest-CPU notes present on the timed arms)."""
+    rec = json.load(open(COMMITTED))
+    assert rec["quick"] is False
+    fb = rec["feed_bytes"]
+    assert fb["capacity"] == 10240 and fb["window_size"] == 60
+    assert fb["bytes_per_window_ratio"] >= 20
+    assert fb["staged_base_ratio"] >= 20
+    rss = rec["month_rss"]
+    assert rss["rows"] == 43200                      # a month of minutes
+    assert rss["peak_rss_mb_with_sparse_corpus"] > 0
+    # dense equivalent stated (computed) so the ceiling claim is explicit
+    assert rss["dense_ring_bytes_computed"] > 10 * rss["sparse_ring_bytes"]
+    assert rec["train"]["loss_parity"] == "bit-identical"
+    assert "honest_cpu" in rec["train"] and "honest_cpu" in rec["serve"]
+
+
+def test_quick_tenk_stats_importable_without_jax_backend():
+    """bench.py's parent process imports this helper for the schema-v9
+    keys; it must stay numpy-only (the never-init-a-backend contract)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '.');"
+         "from benchmarks.tenk_bench import quick_tenk_stats;"
+         "s = quick_tenk_stats(buckets=5);"
+         "import jax._src.xla_bridge as xb;"
+         "assert not xb._backends, 'quick path initialized a JAX backend';"
+         "assert s['bytes_per_window_ratio'] >= 20;"
+         "print(s['tenk_featurize_rows_per_sec'])"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert float(proc.stdout.strip()) > 0
