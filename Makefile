@@ -3,6 +3,9 @@
 #   make lint             graftlint over the package, JSON output (the
 #                         same gate tests/test_lint_clean.py enforces in
 #                         tier-1; see ANALYSIS.md for the rule catalog)
+#   make lint-changed     graftlint scoped to files changed vs git HEAD
+#                         (whole project still parsed for the call
+#                         graph), SARIF output for CI inline annotation
 #   make native           build the C++ featurizer (native/Makefile)
 #   make tsan             build the thread-sanitized featurizer selftest
 #                         — the native-side twin of the TH rule pack
@@ -31,6 +34,9 @@ PYTHON ?= python
 lint:
 	$(PYTHON) -m deeprest_tpu lint --format json
 
+lint-changed:
+	$(PYTHON) -m deeprest_tpu lint --changed --format sarif
+
 native:
 	$(MAKE) -C native
 
@@ -49,5 +55,5 @@ obs-bench:
 tenk-bench:
 	$(PYTHON) benchmarks/tenk_bench.py --out benchmarks/tenk_bench.json
 
-.PHONY: lint native tsan bench-multichip serve-bench-replicas obs-bench \
-	tenk-bench
+.PHONY: lint lint-changed native tsan bench-multichip \
+	serve-bench-replicas obs-bench tenk-bench

@@ -8,21 +8,33 @@ deeprest_tpu.analysis``, or programmatically::
     assert not result.findings
 
 Rule packs: JX (JAX compile/readback/donation invariants — rules_jax),
-TH (threading — rules_threading), HY (hygiene — rules_hygiene), GL
-(framework meta-rules — core).  ANALYSIS.md is the human catalog.
+TH (threading — rules_threading), HY (hygiene — rules_hygiene), OB
+(observability — rules_obs), DN (sparse-first data plane — rules_data),
+RS (resource lifecycle — rules_lifecycle), EX (exception safety —
+rules_exceptions), GL (framework meta-rules — core).  The whole-program
+symbol table / call graph and the path-sensitive paired-operation
+walker live in core (CallGraph, ObligationWalker).  ANALYSIS.md is the
+human catalog.
 """
 
 from deeprest_tpu.analysis.core import (
-    Finding, LintResult, Project, Rule, all_rules, default_baseline_path,
-    lint_paths, lint_project, lint_sources, load_baseline, save_baseline,
+    CallGraph, Finding, FuncKey, LintResult, ObligationWalker, Project,
+    Rule, SuppressionEntry, all_rules, default_baseline_path, lint_paths,
+    lint_project, lint_sources, load_baseline, load_project,
+    save_baseline, suppression_inventory, transitive_closure,
 )
 from deeprest_tpu.analysis.reporters import (
-    render_json, render_rules, render_text,
+    render_json, render_rules, render_sarif, render_suppressions_json,
+    render_suppressions_markdown, render_suppressions_text, render_text,
 )
 
 __all__ = [
-    "Finding", "LintResult", "Project", "Rule", "all_rules",
+    "CallGraph", "Finding", "FuncKey", "LintResult", "ObligationWalker",
+    "Project", "Rule", "SuppressionEntry", "all_rules",
     "default_baseline_path", "lint_paths", "lint_project", "lint_sources",
-    "load_baseline", "save_baseline", "render_json", "render_rules",
+    "load_baseline", "load_project", "save_baseline",
+    "suppression_inventory", "transitive_closure", "render_json",
+    "render_rules", "render_sarif", "render_suppressions_json",
+    "render_suppressions_markdown", "render_suppressions_text",
     "render_text",
 ]

@@ -1,10 +1,13 @@
-"""graftlint output renderers: human text and machine JSON."""
+"""graftlint output renderers: human text, machine JSON, SARIF for CI
+code-review annotation, and the suppression-inventory views."""
 
 from __future__ import annotations
 
 import json
 
-from deeprest_tpu.analysis.core import GL_RULES, LintResult, all_rules
+from deeprest_tpu.analysis.core import (
+    GL_RULES, LintResult, SuppressionEntry, all_rules,
+)
 
 
 def render_text(result: LintResult) -> str:
@@ -44,4 +47,85 @@ def render_rules() -> str:
             lines.append(f"       guards: {rule.guards}")
     for rid, title in sorted(GL_RULES.items()):
         lines.append(f"{rid}  {title} (framework meta-rule)")
+    return "\n".join(lines)
+
+
+def render_sarif(result: LintResult) -> str:
+    """SARIF 2.1.0 — the format CI/code-review systems (GitHub code
+    scanning among them) consume to annotate findings inline on the
+    diff.  Live findings only: baselined/suppressed entries are by
+    definition not actionable on a review."""
+    registry = all_rules()
+    used = sorted({f.rule for f in result.findings})
+    rules_meta = []
+    for rid in used:
+        rule = registry.get(rid)
+        desc = rule.title if rule is not None else GL_RULES.get(rid, rid)
+        meta = {"id": rid, "shortDescription": {"text": desc}}
+        if rule is not None and rule.guards:
+            meta["help"] = {"text": f"guards: {rule.guards}"}
+        rules_meta.append(meta)
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": f.col + 1},
+            },
+        }],
+    } for f in result.findings]
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "ANALYSIS.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }, indent=2, sort_keys=True)
+
+
+# -- suppression inventory views --------------------------------------------
+
+
+def render_suppressions_text(entries: list[SuppressionEntry]) -> str:
+    lines = [f"{e.rule}  {e.path}:{e.line}  -- {e.reason}"
+             for e in entries]
+    lines.append(f"{len(entries)} suppressions across "
+                 f"{len({e.path for e in entries})} files")
+    return "\n".join(lines)
+
+
+def render_suppressions_json(entries: list[SuppressionEntry]) -> str:
+    return json.dumps({
+        "version": 1,
+        "count": len(entries),
+        "suppressions": [e.to_dict() for e in entries],
+    }, indent=2, sort_keys=True)
+
+
+def render_suppressions_markdown(entries: list[SuppressionEntry]) -> str:
+    """The generated ANALYSIS.md table.  Line numbers are deliberately
+    omitted (rows would churn on every unrelated edit); identity is
+    (rule, file, reason) with a count — tests/test_analysis.py pins this
+    rendering against the committed ANALYSIS.md block, so doc and code
+    cannot drift."""
+    grouped: dict[tuple[str, str, str], int] = {}
+    for e in entries:
+        key = (e.rule, e.path, e.reason)
+        grouped[key] = grouped.get(key, 0) + 1
+    lines = ["| Rule | Site | n | Reason |", "|---|---|---|---|"]
+    for (rule, path, reason), n in sorted(grouped.items()):
+        safe = reason.replace("|", "\\|")
+        lines.append(f"| {rule} | `{path}` | {n} | {safe} |")
+    lines.append("")
+    lines.append(f"{len(entries)} suppressions across "
+                 f"{len({e.path for e in entries})} files.")
     return "\n".join(lines)

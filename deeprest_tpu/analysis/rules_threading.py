@@ -31,7 +31,8 @@ import dataclasses
 from typing import Iterator
 
 from deeprest_tpu.analysis.core import (
-    Finding, Project, Rule, SourceFile, call_name, register,
+    CallGraph, Finding, Project, Rule, SourceFile, call_name, register,
+    transitive_closure,
 )
 
 _LOCK_FACTORIES = {
@@ -82,7 +83,8 @@ class Unit:
 
 class ClassModel:
     def __init__(self, sf: SourceFile, node: ast.ClassDef,
-                 module_concurrent: bool):
+                 module_concurrent: bool,
+                 graph: CallGraph | None = None):
         self.sf = sf
         self.node = node
         self.name = node.name
@@ -91,7 +93,18 @@ class ClassModel:
         self.init_written: set[str] = set()
         self.written_outside_init: set[str] = set()
         self.module_concurrent = module_concurrent
+        self._graph = graph
         self._build()
+
+    def method_edges(self) -> dict[str, set[str]]:
+        """method → same-class methods it calls: resolved on the shared
+        project call graph when one is supplied, else from the units'
+        collected self-calls (direct constructions by TH002/TH004 that
+        never propagate thread entries)."""
+        if self._graph is not None:
+            return self._graph.class_method_edges(self.sf.rel, self.name)
+        return {name: set(u.self_calls)
+                for name, u in self.units.items() if "." not in name}
 
     # -- construction ----------------------------------------------------
 
@@ -143,18 +156,20 @@ class ClassModel:
                             and tgt.value.id == self_name
                             and tgt.attr in method_names):
                         self.units[tgt.attr].thread_entry = True
-        # transitive: self.M() calls from thread-entry units
-        changed = True
-        while changed:
-            changed = False
-            for u in self.units.values():
-                if not u.thread_entry:
-                    continue
-                for callee in u.self_calls:
-                    cu = self.units.get(callee)
-                    if cu is not None and not cu.thread_entry:
-                        cu.thread_entry = True
-                        changed = True
+        # transitive: self.M() calls from thread-entry units.  The edge
+        # map and closure are the shared project call graph's (this pack
+        # carried its own while-changed walk until the graph existed);
+        # thread-target LOCAL functions are not graph nodes, so their
+        # collected self-calls seed the closure directly.
+        seeds = {u.name for u in self.units.values()
+                 if u.thread_entry and "." not in u.name}
+        for u in self.units.values():
+            if u.thread_entry and "." in u.name:
+                seeds |= u.self_calls
+        for name in transitive_closure(self.method_edges(), seeds):
+            cu = self.units.get(name)
+            if cu is not None:
+                cu.thread_entry = True
         for u in self.units.values():
             for a in u.accesses:
                 if a.write:
@@ -324,13 +339,14 @@ class TH001AttributeRace(Rule):
               "found and fixed by this rule's first run")
 
     def run(self, project: Project) -> Iterator[Finding]:
+        graph = project.call_graph()
         for sf in project.files:
             if sf.tree is None:
                 continue
             concurrent = _module_concurrent(sf)
             for node in sf.tree.body:
                 if isinstance(node, ast.ClassDef):
-                    model = ClassModel(sf, node, concurrent)
+                    model = ClassModel(sf, node, concurrent, graph=graph)
                     yield from model.races()
                     yield from self._shared_captures(sf, model)
 
@@ -441,16 +457,17 @@ class TH003CrossProcessState(Rule):
               "worker protocol already carries")
 
     def run(self, project: Project) -> Iterator[Finding]:
+        graph = project.call_graph()
         for sf in project.files:
             if sf.tree is None:
                 continue
             for node in sf.tree.body:
                 if isinstance(node, ast.ClassDef):
-                    yield from self._check(sf, node)
+                    yield from self._check(sf, node, graph)
 
-    def _check(self, sf: SourceFile,
-               cnode: ast.ClassDef) -> Iterator[Finding]:
-        model = ClassModel(sf, cnode, False)
+    def _check(self, sf: SourceFile, cnode: ast.ClassDef,
+               graph: CallGraph) -> Iterator[Finding]:
+        model = ClassModel(sf, cnode, False, graph=graph)
         methods = [n for n in cnode.body
                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         method_names = {m.name for m in methods}
@@ -468,19 +485,10 @@ class TH003CrossProcessState(Rule):
                         child_entries.add(tgt.attr)
         if not child_entries:
             return
-        # transitive: self.M() calls from child-side units stay child-side
-        child_units = set(child_entries)
-        changed = True
-        while changed:
-            changed = False
-            for name in list(child_units):
-                u = model.units.get(name)
-                if u is None:
-                    continue
-                for callee in u.self_calls:
-                    if callee in method_names and callee not in child_units:
-                        child_units.add(callee)
-                        changed = True
+        # transitive: self.M() calls from child-side units stay
+        # child-side — the same shared-call-graph closure TH001 uses
+        child_units = {name for name in transitive_closure(
+            model.method_edges(), child_entries) if name in method_names}
         for uname in sorted(child_units):
             u = model.units.get(uname)
             if u is None:

@@ -938,13 +938,48 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def _git_changed_python_files(anchor_dir: str) -> list[str] | None:
+    """Repo-relative .py paths changed vs HEAD (staged + unstaged) plus
+    untracked ones, or None when ``anchor_dir`` is not in a git work
+    tree — the ``deeprest lint --changed`` file selector."""
+    import subprocess
+
+    def git(*argv):
+        return subprocess.run(["git", "-C", anchor_dir, *argv],
+                              capture_output=True, text=True)
+
+    if git("rev-parse", "--show-toplevel").returncode != 0:
+        return None
+    changed: set[str] = set()
+    for argv in (("diff", "--name-only", "HEAD"),
+                 ("ls-files", "--others", "--exclude-standard")):
+        out = git(*argv)
+        if out.returncode != 0:
+            continue
+        changed.update(line.strip() for line in out.stdout.splitlines()
+                       if line.strip().endswith(".py"))
+    return sorted(changed)
+
+
+def _component_suffix_match(a: str, b: str) -> bool:
+    """Lint-root-relative and repo-relative spellings of the same file
+    agree on their trailing path components."""
+    pa = a.replace("\\", "/").split("/")
+    pb = b.replace("\\", "/").split("/")
+    k = min(len(pa), len(pb))
+    return k > 0 and pa[-k:] == pb[-k:]
+
+
 def cmd_lint(args) -> int:
     """graftlint: the repo's JAX- and concurrency-aware static analyzer
     (deeprest_tpu/analysis; rule catalog in ANALYSIS.md).  Exit status:
     0 clean, 1 non-baselined findings, 2 usage error."""
     from deeprest_tpu.analysis import (
-        all_rules, default_baseline_path, lint_paths, load_baseline,
-        render_json, render_rules, render_text, save_baseline,
+        LintResult, all_rules, default_baseline_path, lint_paths,
+        load_baseline, load_project, render_json, render_rules,
+        render_sarif, render_suppressions_json,
+        render_suppressions_markdown, render_suppressions_text,
+        render_text, save_baseline, suppression_inventory,
     )
 
     if args.list_rules:
@@ -962,6 +997,10 @@ def cmd_lint(args) -> int:
         rules = [registry[r] for r in wanted]
     import os
 
+    jobs = args.jobs if args.jobs is not None else (os.cpu_count() or 1)
+    if jobs < 1:
+        print(f"lint: --jobs {jobs} must be >= 1")
+        return 2
     paths = args.paths
     if not paths:
         import deeprest_tpu
@@ -971,20 +1010,66 @@ def cmd_lint(args) -> int:
     if missing:
         print(f"lint: no such path {missing}")
         return 2
+
+    if args.list_suppressions:
+        entries = suppression_inventory(load_project(paths, jobs=jobs))
+        if args.format == "json":
+            print(render_suppressions_json(entries))
+        elif args.format == "markdown":
+            print(render_suppressions_markdown(entries))
+        elif args.format == "text":
+            print(render_suppressions_text(entries))
+        else:
+            print(f"lint: --list-suppressions has no {args.format!r} "
+                  "rendering (text/json/markdown)")
+            return 2
+        return 0
+    if args.format == "markdown":
+        print("lint: --format markdown is the --list-suppressions "
+              "rendering; findings come as text/json/sarif")
+        return 2
+
     baseline_path = args.baseline or default_baseline_path()
     try:
         baseline_keys = load_baseline(baseline_path)
     except ValueError as exc:
         print(f"lint: {exc}")
         return 2
-    result = lint_paths(paths, rules=rules, baseline_keys=baseline_keys)
+    result = lint_paths(paths, rules=rules, baseline_keys=baseline_keys,
+                        jobs=jobs)
     if args.write_baseline:
         save_baseline(baseline_path, result.findings + result.baselined)
         print(f"lint: baselined {len(result.findings + result.baselined)} "
               f"findings to {baseline_path}")
         return 0
-    print(render_json(result) if args.format == "json"
-          else render_text(result))
+    scope_note = ""
+    if args.changed:
+        anchor = paths[0] if os.path.isdir(paths[0]) else os.path.dirname(
+            os.path.abspath(paths[0]))
+        changed = _git_changed_python_files(anchor)
+        if changed is None:
+            print(f"lint: --changed needs a git work tree around "
+                  f"{anchor!r}")
+            return 2
+        # the WHOLE project is still parsed (cross-module rules need the
+        # full symbol table / call graph); only the REPORT is scoped
+        result = LintResult(
+            findings=[f for f in result.findings
+                      if any(_component_suffix_match(f.path, c)
+                             for c in changed)],
+            baselined=[f for f in result.baselined
+                       if any(_component_suffix_match(f.path, c)
+                              for c in changed)],
+            suppressed_count=result.suppressed_count,
+            files=result.files)
+        scope_note = (f" [--changed: findings scoped to {len(changed)} "
+                      "changed file(s); whole project parsed]")
+    if args.format == "sarif":
+        print(render_sarif(result))
+    elif args.format == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result) + scope_note)
     return 1 if result.findings else 0
 
 
@@ -1348,9 +1433,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: the installed "
                         "deeprest_tpu package)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif",
+                                        "markdown"), default="text",
+                   help="findings as text/json/sarif (SARIF 2.1.0 for "
+                        "CI inline annotation); markdown renders the "
+                        "--list-suppressions table")
     p.add_argument("--rules", default=None, metavar="JX001,TH001,...",
                    help="run only these rule ids (default: all)")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files changed vs git "
+                        "HEAD (plus untracked); the whole project is "
+                        "still parsed so cross-module rules keep their "
+                        "call graph (make lint-changed)")
+    p.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="parse the project across N worker processes "
+                        "(default: os.cpu_count(); small trees parse "
+                        "serially regardless)")
+    p.add_argument("--list-suppressions", action="store_true",
+                   help="emit the live suppression inventory (rule, "
+                        "file:line, reason) instead of linting; "
+                        "--format markdown renders the generated "
+                        "ANALYSIS.md table")
     p.add_argument("--baseline", default=None,
                    help="baseline JSON path (default: the checked-in "
                         "deeprest_tpu/analysis/baseline.json, which is "

@@ -54,13 +54,15 @@ def snsd_available() -> bool:
 
 def _free_ports(n: int) -> list[int]:
     socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            socks.append(s)        # owned by the finally from birth
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
     return ports
 
 

@@ -25,6 +25,8 @@ import os
 import threading
 import time
 
+import numpy as np
+
 _capture_lock = threading.Lock()
 
 
@@ -85,7 +87,6 @@ def measure_step_breakdown(trainer, x, y, w, steps: int = 10,
     """
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     ledger = {"started": 0, "synced": 0}
 

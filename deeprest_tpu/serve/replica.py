@@ -37,7 +37,9 @@ outstanding-work signal its least-outstanding-work dispatch reads.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 
 import numpy as np
 
@@ -239,8 +241,6 @@ class EngineReplica:
 
     def wait_idle(self, timeout_s: float = 30.0) -> bool:
         """Block until every dispatched window has completed."""
-        import time
-
         deadline = time.monotonic() + timeout_s
         with self._cv:
             while self._outstanding > 0:
@@ -324,8 +324,6 @@ def _device_ctx(device):
     exactly the waste the shared-stack plane avoids.  Committed params
     (clone_backend's device_put) pin Predictor dispatches regardless; the
     context covers uncommitted-input backends (exported artifacts)."""
-    import contextlib
-
     import jax
 
     if device is None:
@@ -448,17 +446,22 @@ def _worker_main(spec: dict, conn) -> None:
                     with send_lock:
                         conn.send(("__spans__", True, batch))
 
-    with ThreadPoolExecutor(max_workers=int(spec.get("worker_threads", 4))) \
-            as pool:
-        while True:
-            try:
-                msg = conn.recv()
-            except EOFError:
-                break
-            if msg is None:            # shutdown sentinel
-                break
-            pool.submit(handle, *msg)
-    conn.close()
+    try:
+        with ThreadPoolExecutor(
+                max_workers=int(spec.get("worker_threads", 4))) as pool:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break              # parent went away: drain and exit
+                if msg is None:        # shutdown sentinel
+                    break
+                pool.submit(handle, *msg)
+    finally:
+        # close the child's pipe end on EVERY exit path (a handler bug
+        # escaping the pool must not strand the parent's reader thread
+        # on a half-open pipe)
+        conn.close()
 
 
 class ProcessReplica:
@@ -508,20 +511,31 @@ class ProcessReplica:
 
         ctx = mp.get_context("spawn")  # fork after jax init is unsafe
         conn, child = ctx.Pipe(duplex=True)
-        proc = ctx.Process(target=_worker_main, args=(self.spec, child),
-                           daemon=True)
-        proc.start()
-        child.close()
-        if not conn.poll(self.boot_timeout_s):
+        proc = None
+        try:
+            proc = ctx.Process(target=_worker_main,
+                               args=(self.spec, child), daemon=True)
+            proc.start()
+            child.close()
+            if not conn.poll(self.boot_timeout_s):
+                raise RuntimeError(
+                    f"replica {self.name}: worker boot timed out")
+            # recv itself can raise (EOFError when the worker dies after
+            # start but before the handshake lands) — the except below
+            # owns cleanup for EVERY failed-boot path, so no path leaks
+            # a pipe end or a live subprocess (graftlint RS001)
+            tag, ok, meta = conn.recv()
+            if tag != "__boot__" or not ok:
+                raise RuntimeError(f"replica {self.name}: worker failed "
+                                   f"to boot: {meta}")
+        except Exception:
             conn.close()
-            proc.terminate()
-            raise RuntimeError(f"replica {self.name}: worker boot timed out")
-        tag, ok, meta = conn.recv()
-        if tag != "__boot__" or not ok:
-            conn.close()
-            proc.join(timeout=5)
-            raise RuntimeError(f"replica {self.name}: worker failed to "
-                               f"boot: {meta}")
+            child.close()
+            if proc is not None and proc.pid is not None:
+                if proc.is_alive():
+                    proc.terminate()
+                proc.join(timeout=5)
+            raise
         with self._lock:
             self._conn = conn
             self._proc = proc
@@ -639,8 +653,6 @@ class ProcessReplica:
             self._draining = False
 
     def wait_idle(self, timeout_s: float = 30.0) -> bool:
-        import time
-
         deadline = time.monotonic() + timeout_s
         with self._cv:
             while self._outstanding > 0:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 
 import numpy as np
 
@@ -385,7 +386,15 @@ class ReplicaRouter:
             spec["batching"] = {"max_batch": batching.max_batch,
                                "max_linger_s": batching.max_linger_s,
                                "max_queue": batching.max_queue}
-        replicas = [ProcessReplica(spec, name=f"p{i}") for i in range(n)]
+        replicas = []
+        try:
+            for i in range(n):
+                replicas.append(ProcessReplica(spec, name=f"p{i}"))
+        except Exception:
+            # a failing Nth boot must not leak the N-1 live workers
+            for r in replicas:
+                r.close()
+            raise
         return cls(replicas, config=config, batching=batching)
 
     # -- serving protocol (what PredictionService consumes) --------------
@@ -436,8 +445,6 @@ class ReplicaRouter:
     def _pick(self):
         """Least-outstanding-work replica (ties: round-robin), waiting
         briefly through a rolling reload's drain gap."""
-        import time
-
         deadline = time.monotonic() + 5.0
         while True:
             with self._lock:
@@ -548,16 +555,30 @@ class ReplicaRouter:
                 keep, drop = self._replicas[:n], self._replicas[n:]
                 self._replicas = keep
             for r in drop:
+                # graftlint: disable=RS002 -- designed sink: a dropped replica sharing its stack with a survivor stays drained (the survivor owns the stack); non-shared drops are closed below on every path
                 r.drain()
+            errors = []
             for r in drop:
-                r.wait_idle(timeout_s=30.0)
-                # shared-stack replicas must not close the survivors' stack
-                shared = any(
-                    callable(getattr(k, "backend", None))
-                    and callable(getattr(r, "backend", None))
-                    and k.backend() is r.backend() for k in keep)
-                if not shared:
-                    r.close()
+                # one replica's failing drain-wait/close must not leave
+                # the REST of the shrink set drained-but-live (graftlint
+                # EX002: stranded between publish points) — reclaim every
+                # replica, then report the failures together
+                try:
+                    r.wait_idle(timeout_s=30.0)
+                    # shared-stack replicas must not close the
+                    # survivors' stack
+                    shared = any(
+                        callable(getattr(k, "backend", None))
+                        and callable(getattr(r, "backend", None))
+                        and k.backend() is r.backend() for k in keep)
+                    if not shared:
+                        r.close()
+                except Exception as exc:
+                    errors.append(f"{r.name}: {type(exc).__name__}: {exc}")
+            if errors:
+                raise ServingError(
+                    "scale_to shrink could not reclaim every replica: "
+                    + "; ".join(errors), status=500)
             return n
         lead = replicas[0]
         with self._lock:
@@ -587,8 +608,16 @@ class ReplicaRouter:
         else:                                              # process plane
             from deeprest_tpu.serve.replica import ProcessReplica
 
-            fresh = [ProcessReplica(lead.spec, name=f"p{i}")
-                     for i in range(len(replicas), n)]
+            fresh = []
+            try:
+                for i in range(len(replicas), n):
+                    fresh.append(ProcessReplica(lead.spec, name=f"p{i}"))
+            except Exception:
+                # a failing Nth boot must not leak the N-1 workers
+                # already spawned (their subprocesses outlive the call)
+                for r in fresh:
+                    r.close()
+                raise
         with self._lock:
             self._replicas.extend(fresh)
         return n
@@ -606,6 +635,7 @@ class ReplicaRouter:
             backend = getattr(r, "backend", None)
             key = id(backend()) if callable(backend) else id(r)
             if key in seen:
+                # graftlint: disable=RS002 -- designed shutdown sink: shared-stack duplicates drain forever; the stack (and its batcher) is closed once, via the first replica of the group
                 r.drain()
                 continue
             seen.add(key)

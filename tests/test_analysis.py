@@ -1016,6 +1016,773 @@ def test_rule_registry_complete():
     rules = all_rules()
     assert {"JX001", "JX002", "JX003", "JX004",
             "TH001", "TH002", "TH003", "TH004",
-            "HY001", "HY002", "OB001", "DN001"} <= set(rules)
+            "HY001", "HY002", "OB001", "DN001",
+            "RS001", "RS002", "RS003",
+            "EX001", "EX002", "EX003"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards
+
+
+# ---------------------------------------------------------------------------
+# the whole-program call graph (core.CallGraph)
+
+
+CG_WORKERS = """
+import threading
+
+def make_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+
+def make_indirect(fn):
+    return make_worker(fn)
+
+class Pool:
+    def spawn(self, fn):
+        return self._spawn_impl(fn)
+
+    def _spawn_impl(self, fn):
+        return make_worker(fn)
+"""
+
+
+def test_call_graph_resolves_self_module_and_cross_module_calls():
+    from deeprest_tpu.analysis.core import FuncKey, Project
+
+    caller = """
+from pkg.workers import make_worker
+import pkg.workers
+
+def local_helper():
+    pass
+
+def run(fn):
+    local_helper()
+    t = make_worker(fn)
+    u = pkg.workers.make_indirect(fn)
+    return t, u
+"""
+    project = Project.from_sources({"pkg/workers.py": CG_WORKERS,
+                                    "pkg/caller.py": caller})
+    graph = project.call_graph()
+    run_key = FuncKey("pkg/caller.py", None, "run")
+    edges = graph.edges(run_key)
+    assert FuncKey("pkg/caller.py", None, "local_helper") in edges
+    assert FuncKey("pkg/workers.py", None, "make_worker") in edges
+    assert FuncKey("pkg/workers.py", None, "make_indirect") in edges
+    # self._helper() resolves within the class
+    spawn = FuncKey("pkg/workers.py", "Pool", "spawn")
+    assert FuncKey("pkg/workers.py", "Pool", "_spawn_impl") \
+        in graph.edges(spawn)
+    assert graph.class_method_edges("pkg/workers.py", "Pool")["spawn"] \
+        == {"_spawn_impl"}
+
+
+def test_call_graph_reachable_is_depth_bounded():
+    from deeprest_tpu.analysis.core import FuncKey, Project
+
+    chain = "\n".join(
+        [f"def f{i}():\n    return f{i + 1}()" for i in range(12)]
+        + ["def f12():\n    return 0"])
+    project = Project.from_sources({"chain.py": chain})
+    graph = project.call_graph()
+    seed = {FuncKey("chain.py", None, "f0")}
+    shallow = graph.reachable(seed, max_depth=3)
+    assert FuncKey("chain.py", None, "f3") in shallow
+    assert FuncKey("chain.py", None, "f5") not in shallow
+    deep = graph.reachable(seed)        # the default bounded depth
+    assert FuncKey("chain.py", None, "f8") in deep
+
+
+def test_call_graph_ambiguous_module_suffix_resolves_to_nothing():
+    from deeprest_tpu.analysis.core import Project
+
+    a = "def fn():\n    return 1\n"
+    b = "def fn():\n    return 2\n"
+    caller = "from util import fn\n\ndef go():\n    return fn()\n"
+    project = Project.from_sources({
+        "red/util.py": a, "blue/util.py": b, "app/caller.py": caller})
+    graph = project.call_graph()
+    # "util" is ambiguous between two files: the graph must not guess
+    assert graph.resolve_module(("util",)) is None
+
+
+# ---------------------------------------------------------------------------
+# RS001: spawned resources discharged on every path
+
+
+RS001_THREAD_BAD = """
+import threading
+
+def run(work):
+    t = threading.Thread(target=work)
+    t.start()
+    work.wait()
+"""
+
+RS001_THREAD_GOOD = """
+import threading
+
+def run(work):
+    t = threading.Thread(target=work)
+    t.start()
+    try:
+        work.wait()
+    finally:
+        t.join()
+"""
+
+RS001_THREAD_DAEMON = """
+import threading
+
+def run(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    work.wait()
+"""
+
+
+def test_rs001_thread_pair():
+    assert_pair("RS001", RS001_THREAD_BAD, RS001_THREAD_GOOD)
+
+
+def test_rs001_daemon_thread_is_silent():
+    # a daemon thread dies with the process — no join obligation (a
+    # daemon PROCESS still zombies until reaped and is NOT exempt)
+    assert not findings_for("RS001", RS001_THREAD_DAEMON)
+
+
+RS001_BOOT_BAD = """
+import multiprocessing as mp
+
+class Replica:
+    def _boot(self, spec):
+        ctx = mp.get_context("spawn")
+        conn, child = ctx.Pipe(duplex=True)
+        proc = ctx.Process(target=worker, args=(spec, child), daemon=True)
+        proc.start()
+        child.close()
+        tag, ok, meta = conn.recv()   # EOFError here leaks conn AND proc
+        self._conn = conn
+        self._proc = proc
+"""
+
+RS001_BOOT_GOOD = """
+import multiprocessing as mp
+
+class Replica:
+    def _boot(self, spec):
+        ctx = mp.get_context("spawn")
+        conn, child = ctx.Pipe(duplex=True)
+        proc = None
+        try:
+            proc = ctx.Process(target=worker, args=(spec, child),
+                               daemon=True)
+            proc.start()
+            child.close()
+            tag, ok, meta = conn.recv()
+            if not ok:
+                raise RuntimeError(meta)
+        except Exception:
+            conn.close()
+            child.close()
+            if proc is not None and proc.pid is not None:
+                if proc.is_alive():
+                    proc.terminate()
+                proc.join(timeout=5)
+            raise
+        self._conn = conn
+        self._proc = proc
+"""
+
+
+def test_rs001_worker_boot_pair():
+    # the round-16 incident shape: the handshake recv raising with the
+    # worker subprocess and both pipe ends live
+    fired = findings_for("RS001", RS001_BOOT_BAD, rel="serve/replica.py")
+    kinds = {f.message.split()[0] for f in fired}
+    assert "pipe" in kinds and "process" in kinds, fired
+    assert not findings_for("RS001", RS001_BOOT_GOOD,
+                            rel="serve/replica.py")
+
+
+def test_rs001_escape_to_owner_discharges():
+    # storing the handle on self transfers ownership — no leak even
+    # though this function never joins
+    src = """
+import threading
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._serve)
+        self._thread.start()
+        return self
+"""
+    assert not findings_for("RS001", src)
+
+
+RS001_PROFILER_BAD = """
+import jax
+
+def capture(out_dir, seconds):
+    jax.profiler.start_trace(out_dir)
+    work(seconds)
+    jax.profiler.stop_trace()
+"""
+
+RS001_PROFILER_GOOD = """
+import jax
+
+def capture(out_dir, seconds):
+    jax.profiler.start_trace(out_dir)
+    try:
+        work(seconds)
+    finally:
+        jax.profiler.stop_trace()
+"""
+
+
+def test_rs001_profiler_window_pair():
+    assert_pair("RS001", RS001_PROFILER_BAD, RS001_PROFILER_GOOD,
+                rel="obs/profiler.py")
+
+
+def test_rs001_profiler_stop_through_local_wrapper_is_silent():
+    # cli.py's shape: stop_trace lives in a local def the finally calls
+    src = """
+import jax
+
+def train(profile_dir):
+    jax.profiler.start_trace(profile_dir)
+
+    def stop_profiling():
+        jax.profiler.stop_trace()
+
+    try:
+        fit()
+    finally:
+        stop_profiling()
+"""
+    assert not findings_for("RS001", src)
+
+
+def test_rs001_cross_module_factory_pair():
+    # the call graph resolves a factory in ANOTHER module that returns a
+    # started thread; the caller owns the join obligation
+    caller_bad = """
+from pkg.workers import make_worker
+
+def run(fn, work):
+    t = make_worker(fn)
+    work.wait()
+"""
+    caller_good = """
+from pkg.workers import make_worker
+
+def run(fn, work):
+    t = make_worker(fn)
+    try:
+        work.wait()
+    finally:
+        t.join()
+"""
+    from deeprest_tpu.analysis import lint_sources
+
+    rules = [all_rules()["RS001"]]
+    bad = lint_sources({"pkg/workers.py": CG_WORKERS,
+                        "pkg/caller.py": caller_bad}, rules=rules)
+    assert [f for f in bad.findings if f.path == "pkg/caller.py"], \
+        "cross-module factory leak must fire in the CALLER"
+    good = lint_sources({"pkg/workers.py": CG_WORKERS,
+                         "pkg/caller.py": caller_good}, rules=rules)
+    assert not good.findings
+
+
+def test_rs001_cross_module_wrapper_chain_resolves():
+    # a wrapper of a wrapper: make_indirect -> make_worker -> Thread
+    caller = """
+from pkg.workers import make_indirect
+
+def run(fn, work):
+    t = make_indirect(fn)
+    work.wait()
+"""
+    from deeprest_tpu.analysis import lint_sources
+
+    res = lint_sources({"pkg/workers.py": CG_WORKERS,
+                        "pkg/caller.py": caller},
+                       rules=[all_rules()["RS001"]])
+    assert [f for f in res.findings if f.path == "pkg/caller.py"]
+
+
+def test_rs001_with_statement_file_is_silent():
+    src = """
+def read(path):
+    with open(path) as f:
+        return f.read()
+"""
+    assert not findings_for("RS001", src)
+
+
+# ---------------------------------------------------------------------------
+# RS002: lifecycle drain without resume/close (serve/ watchlist)
+
+
+RS002_BAD = """
+class Router:
+    def stop_half(self, replicas):
+        for r in replicas:
+            r.drain()
+"""
+
+RS002_GOOD = """
+class Router:
+    def stop_half(self, replicas):
+        for r in replicas:
+            r.drain()
+        for r in replicas:
+            r.close()
+"""
+
+
+def test_rs002_pair():
+    assert_pair("RS002", RS002_BAD, RS002_GOOD, rel="serve/router.py")
+
+
+def test_rs002_early_return_between_drain_and_resume_fires():
+    src = """
+class Router:
+    def reload(self, r, fresh):
+        r.drain()
+        if fresh is None:
+            return None
+        r.reload_backend(fresh)
+        r.resume()
+"""
+    fired = findings_for("RS002", src, rel="serve/router.py")
+    assert fired and "resume" in fired[0].message
+
+
+def test_rs002_data_pop_drain_is_silent():
+    # the span ring's drain() RETURNS the popped records — consuming the
+    # result marks it a data pop, not a lifecycle pause
+    src = """
+class Forwarder:
+    def flush(self, recorder, conn):
+        batch = [r.to_dict() for r in recorder.drain()]
+        if batch:
+            conn.send(batch)
+"""
+    assert not findings_for("RS002", src, rel="serve/replica.py")
+
+
+def test_rs002_outside_serve_watchlist_is_silent():
+    assert not findings_for("RS002", RS002_BAD, rel="train/stream.py")
+
+
+# ---------------------------------------------------------------------------
+# RS003: __del__-reliance on hot objects
+
+
+RS003_BAD = """
+class Replica:
+    def __del__(self):
+        self._conn.close()
+"""
+
+RS003_GOOD = """
+class Replica:
+    def close(self):
+        self._conn.close()
+"""
+
+
+def test_rs003_pair():
+    assert_pair("RS003", RS003_BAD, RS003_GOOD, rel="serve/replica.py")
+
+
+def test_rs003_non_cleanup_del_and_non_hot_dirs_are_silent():
+    trivial = """
+class Counted:
+    def __del__(self):
+        _COUNT.discard(id(self))
+"""
+    assert not findings_for("RS003", trivial, rel="serve/replica.py")
+    assert not findings_for("RS003", RS003_BAD, rel="data/ingest.py")
+
+
+# ---------------------------------------------------------------------------
+# EX001: bare lock acquire not released on a raising path
+
+
+EX001_BAD = """
+import threading
+_lock = threading.Lock()
+
+def handle(req):
+    _lock.acquire()
+    out = work(req)
+    _lock.release()
+    return out
+"""
+
+EX001_GOOD = """
+import threading
+_lock = threading.Lock()
+
+def handle(req):
+    _lock.acquire()
+    try:
+        return work(req)
+    finally:
+        _lock.release()
+"""
+
+
+def test_ex001_pair():
+    assert_pair("EX001", EX001_BAD, EX001_GOOD)
+
+
+def test_ex001_fast_fail_acquire_shape_is_silent():
+    # obs/profiler.py's capture window: on the `not acquire(...)` branch
+    # the lock was never taken, so the raise there holds nothing
+    src = """
+import threading
+_lock = threading.Lock()
+
+def capture(seconds):
+    if not _lock.acquire(blocking=False):
+        raise RuntimeError("busy")
+    try:
+        return window(seconds)
+    finally:
+        _lock.release()
+"""
+    assert not findings_for("EX001", src)
+
+
+def test_ex001_with_lock_is_silent():
+    src = """
+import threading
+_lock = threading.Lock()
+
+def handle(req):
+    with _lock:
+        return work(req)
+"""
+    assert not findings_for("EX001", src)
+
+
+def test_ex001_early_return_with_lock_held_fires():
+    src = """
+import threading
+_lock = threading.Lock()
+
+def peek(flag):
+    _lock.acquire()
+    if flag:
+        return True
+    _lock.release()
+    return False
+"""
+    fired = findings_for("EX001", src)
+    assert fired and "not released" in fired[0].message
+
+
+# ---------------------------------------------------------------------------
+# EX002: exception strands the plane between paired publish points
+
+
+EX002_BAD = """
+class Router:
+    def reload(self, replicas, fresh):
+        for r in replicas:
+            r.drain()
+        for r in replicas:
+            r.wait_idle()
+            r.reload_backend(fresh)
+            r.resume()
+"""
+
+EX002_GOOD = """
+class Router:
+    def reload(self, replicas, fresh):
+        for r in replicas:
+            r.drain()
+        try:
+            for r in replicas:
+                r.wait_idle()
+                r.reload_backend(fresh)
+        finally:
+            for r in replicas:
+                r.resume()
+"""
+
+
+def test_ex002_pair():
+    assert_pair("EX002", EX002_BAD, EX002_GOOD, rel="serve/router.py")
+
+
+def test_ex002_caught_region_is_silent():
+    # a per-replica except that keeps reclaiming the rest (scale_to's
+    # fixed shape) absorbs the exception edge; the handler body must
+    # itself be non-raising bookkeeping, or IT re-strands the plane
+    src = """
+class Router:
+    def shrink(self, drop):
+        errors = []
+        for r in drop:
+            r.drain()
+        for r in drop:
+            try:
+                r.wait_idle()
+                r.close()
+            except Exception as exc:
+                errors.append(str(exc))
+"""
+    assert not findings_for("EX002", src, rel="serve/router.py")
+
+
+def test_ex002_outside_serve_watchlist_is_silent():
+    assert not findings_for("EX002", EX002_BAD, rel="obs/spans.py")
+
+
+# ---------------------------------------------------------------------------
+# EX003: swallowed exceptions in the serve/train/obs watchlists
+
+
+EX003_BAD = """
+def poll(conn):
+    try:
+        return conn.recv()
+    except Exception:
+        pass
+"""
+
+EX003_GOOD = """
+def poll(conn):
+    try:
+        return conn.recv()
+    except Exception as exc:
+        RECORDER.note_error(exc)
+        return None
+"""
+
+
+def test_ex003_pair():
+    assert_pair("EX003", EX003_BAD, EX003_GOOD, rel="serve/server.py")
+    assert_pair("EX003", EX003_BAD, EX003_GOOD, rel="train/stream.py")
+    assert_pair("EX003", EX003_BAD, EX003_GOOD, rel="obs/spans.py")
+
+
+def test_ex003_bare_except_fires():
+    src = "def f():\n    try:\n        g()\n    except:\n        pass\n"
+    fired = findings_for("EX003", src, rel="serve/x.py")
+    assert fired and "bare except" in fired[0].message
+
+
+def test_ex003_narrow_typed_except_pass_is_silent():
+    # best-effort shutdown sends on a closing pipe are a deliberate idiom
+    src = """
+def shutdown(conn):
+    try:
+        conn.send(None)
+    except (OSError, BrokenPipeError):
+        pass
+"""
+    assert not findings_for("EX003", src, rel="serve/replica.py")
+
+
+def test_ex003_outside_watchlists_is_silent():
+    assert not findings_for("EX003", EX003_BAD, rel="loadgen/cluster.py")
+
+
+# ---------------------------------------------------------------------------
+# TH001/TH003 call-graph migration: pre-migration verdicts, bit for bit
+
+
+def test_th001_th003_verdicts_unchanged_after_callgraph_migration():
+    """The transitive walks moved onto core.CallGraph; these verdicts
+    were captured from the PRE-migration rule packs and must reproduce
+    exactly (path, line, col, rule, full message)."""
+    expected = {
+        ("TH001", TH001_BAD): [
+            ("mod.py", 11, 0, "TH001",
+             "Service.count is written in _worker() (thread-side, no "
+             "lock) and accessed in healthz() line 14 (no lock) — a "
+             "data race between the class's threads; hold self._lock "
+             "around every access")],
+        ("TH001", TH001_GOOD): [],
+        ("TH003", TH003_BAD): [
+            ("mod.py", 11, 0, "TH003",
+             "Replica.served is written in _worker() — a "
+             "multiprocessing child entry — and read parent-side in "
+             "outstanding() line 14; the child mutates its OWN copy of "
+             "the object, so the parent never observes this write.  "
+             "Route it through the process boundary explicitly "
+             "(Pipe/Queue/Value/shared memory)")],
+        ("TH003", TH003_GOOD): [],
+    }
+    for (rid, src), want in expected.items():
+        result = lint_sources({"mod.py": src}, rules=[all_rules()[rid]])
+        got = [(f.path, f.line, f.col, f.rule, f.message)
+               for f in result.findings]
+        assert got == want, f"{rid} verdict drifted: {got}"
+
+
+# ---------------------------------------------------------------------------
+# reporters: SARIF + suppression inventory
+
+
+def test_sarif_reporter_schema():
+    from deeprest_tpu.analysis import render_sarif
+
+    result = lint_sources({"mod.py": "import os\nprint(1)\n"})
+    payload = json.loads(render_sarif(result))
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert any(r["id"] == "HY001"
+               for r in run["tool"]["driver"]["rules"])
+    res = run["results"][0]
+    assert res["ruleId"] == "HY001"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] == 1
+    assert loc["region"]["startColumn"] >= 1
+
+
+def test_suppression_inventory_and_renderings():
+    from deeprest_tpu.analysis import (
+        Project, render_suppressions_json, render_suppressions_markdown,
+        render_suppressions_text, suppression_inventory,
+    )
+
+    src = ("import threading\n"
+           "# graftlint: disable=HY001 -- kept for the doc example\n"
+           "import os\n"
+           "# graftlint: disable=HY001\n"
+           "import sys\n")
+    entries = suppression_inventory(Project.from_sources({"m.py": src}))
+    # the reasonless disable is a GL001 finding, NOT an inventory row
+    assert [(e.rule, e.path, e.line) for e in entries] \
+        == [("HY001", "m.py", 2)]
+    text = render_suppressions_text(entries)
+    assert "HY001  m.py:2  -- kept for the doc example" in text
+    payload = json.loads(render_suppressions_json(entries))
+    assert payload["count"] == 1
+    md = render_suppressions_markdown(entries)
+    assert "| HY001 | `m.py` | 1 | kept for the doc example |" in md
+    assert "m.py:2" not in md      # line numbers would churn the doc
+
+
+# ---------------------------------------------------------------------------
+# CLI: --changed, --list-suppressions, --jobs
+
+
+def test_cli_lint_changed_scopes_findings_to_git_diff(tmp_path):
+    import shutil
+    import subprocess
+
+    from deeprest_tpu.cli import main
+
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*argv):
+        return subprocess.run(
+            ["git", "-C", str(repo), *argv], capture_output=True,
+            text=True, env={"GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t",
+                            "HOME": str(tmp_path), "PATH": "/usr/bin:/bin"})
+
+    assert git("init", "-q").returncode == 0
+    clean = repo / "clean.py"
+    dirty = repo / "dirty.py"
+    clean.write_text("import os\nprint(1)\n")      # committed finding
+    dirty.write_text("print(1)\n")
+    git("add", ".")
+    assert git("commit", "-q", "-m", "seed").returncode == 0
+    dirty.write_text("import sys\nprint(1)\n")     # NEW finding, changed
+
+    baseline = tmp_path / "b.json"
+    # unscoped: both files' findings fail the run
+    assert main(["lint", str(repo), "--baseline", str(baseline)]) == 1
+    # --changed: only dirty.py's finding is reported; it still fails...
+    assert main(["lint", str(repo), "--baseline", str(baseline),
+                 "--changed", "--format", "json"]) == 1
+    # ...and with only clean.py's finding live, --changed exits 0
+    dirty.write_text("print(1)\n")
+    assert main(["lint", str(repo), "--baseline", str(baseline),
+                 "--changed"]) == 0
+
+
+def test_cli_lint_changed_json_only_reports_changed_files(tmp_path,
+                                                          capsys):
+    import shutil
+    import subprocess
+
+    from deeprest_tpu.cli import main
+
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "HOME": str(tmp_path), "PATH": "/usr/bin:/bin"}
+    subprocess.run(["git", "-C", str(repo), "init", "-q"], env=env)
+    (repo / "clean.py").write_text("import os\nprint(1)\n")
+    subprocess.run(["git", "-C", str(repo), "add", "."], env=env)
+    subprocess.run(["git", "-C", str(repo), "commit", "-q", "-m", "s"],
+                   env=env)
+    (repo / "dirty.py").write_text("import sys\nprint(1)\n")  # untracked
+
+    main(["lint", str(repo), "--baseline", str(tmp_path / "b.json"),
+          "--changed", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    paths = {f["path"] for f in payload["findings"]}
+    assert paths == {"dirty.py"}
+
+
+def test_cli_list_suppressions(tmp_path, capsys):
+    from deeprest_tpu.cli import main
+
+    f = tmp_path / "m.py"
+    f.write_text("# graftlint: disable=HY001 -- doc example\n"
+                 "import os\nprint(1)\n")
+    assert main(["lint", str(f), "--list-suppressions"]) == 0
+    out = capsys.readouterr().out
+    assert "HY001  m.py:1  -- doc example" in out
+    assert main(["lint", str(f), "--list-suppressions",
+                 "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] == 1
+    assert payload["suppressions"][0]["rule"] == "HY001"
+
+
+def test_parallel_parse_matches_serial(tmp_path, monkeypatch):
+    from deeprest_tpu.analysis import core as analysis_core
+
+    paths = []
+    for i in range(30):
+        p = tmp_path / f"m{i}.py"
+        p.write_text(f"import os\n\ndef f{i}():\n    return {i}\n")
+        paths.append((f"m{i}.py", str(p)))
+    serial = analysis_core.parse_files(paths, jobs=1)
+    monkeypatch.setattr(analysis_core, "_PARALLEL_MIN_FILES", 8)
+    parallel = analysis_core.parse_files(paths, jobs=2)
+    assert [(s.rel, s.source) for s in serial] \
+        == [(s.rel, s.source) for s in parallel]
+    # parsed trees survive the pool round-trip
+    assert all(s.tree is not None for s in parallel)
+    from deeprest_tpu.analysis.core import Project, lint_project
+
+    a = lint_project(Project(serial))
+    b = lint_project(Project(parallel))
+    assert [f.key() for f in a.findings] == [f.key() for f in b.findings]

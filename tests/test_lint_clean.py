@@ -5,11 +5,17 @@ This is the enforcement half of graftlint: tests/test_analysis.py proves
 each rule fires and stays silent correctly; this test pins deeprest_tpu
 itself at zero non-baselined findings forever.  A PR that introduces a
 jit closure capture (JX001/PR 4 bug class), a recompile hazard, an
-off-lock shared attribute (TH001), or a lock cycle fails tier-1 here —
-the same way a racy native featurizer change fails the tsan selftest.
+off-lock shared attribute (TH001), a leaked worker pipe (RS001), a
+drained-and-stranded replica (RS002/EX002), or a lock cycle fails
+tier-1 here — the same way a racy native featurizer change fails the
+tsan selftest.
 
-Budget: the whole run (parse + all rule packs over ~60 files) must stay
-well under 10 s so it remains a tier-1 test.
+Budget: the whole run — parse, the whole-program call graph, and every
+rule pack (RS/EX's path-sensitive walkers included) over ~75 files —
+must stay under 10 s so it remains a tier-1 test.
+
+Also pinned here: ANALYSIS.md's generated suppression table matches the
+live in-code inventory exactly (doc-vs-code drift is a failure).
 """
 
 import os
@@ -17,10 +23,12 @@ import time
 
 import deeprest_tpu
 from deeprest_tpu.analysis import (
-    default_baseline_path, lint_paths, load_baseline, render_text,
+    default_baseline_path, lint_paths, load_baseline, load_project,
+    render_suppressions_markdown, render_text, suppression_inventory,
 )
 
 PACKAGE_DIR = os.path.dirname(os.path.abspath(deeprest_tpu.__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_package_lints_clean_with_empty_baseline():
@@ -44,3 +52,26 @@ def test_suppressions_all_carry_reasons():
     # explicit: every in-code deviation must say WHY.
     result = lint_paths([PACKAGE_DIR], rules=[])
     assert not [f for f in result.findings if f.rule == "GL001"]
+
+
+def test_analysis_md_suppression_table_matches_live_inventory():
+    """ANALYSIS.md's suppression table is GENERATED (`deeprest lint
+    --list-suppressions --format markdown`); this pin makes doc-vs-code
+    drift a tier-1 failure.  Regenerate the block between the markers
+    after adding/removing a suppression."""
+    md_path = os.path.join(REPO_ROOT, "ANALYSIS.md")
+    if not os.path.exists(md_path):
+        import pytest
+
+        pytest.skip("ANALYSIS.md not present in this checkout")
+    content = open(md_path, encoding="utf-8").read()
+    begin, end = "<!-- suppressions:begin -->", "<!-- suppressions:end -->"
+    assert begin in content and end in content, \
+        "ANALYSIS.md lost its generated-suppressions markers"
+    committed = content.split(begin, 1)[1].split(end, 1)[0].strip()
+    live = render_suppressions_markdown(
+        suppression_inventory(load_project([PACKAGE_DIR]))).strip()
+    assert committed == live, (
+        "ANALYSIS.md's suppression table drifted from the code; "
+        "regenerate it:\n  python -m deeprest_tpu lint "
+        "--list-suppressions --format markdown")
