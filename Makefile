@@ -27,7 +27,14 @@
 #                         featurize → ring → feed bytes → train → serve →
 #                         peak RSS, dense vs padded-COO) — refreshes
 #                         benchmarks/tenk_bench.json; the on-chip run
-#                         rides benchmarks/tpu_queue.sh tenk_vertical
+#                         rides benchmarks/tpu_queue.sh
+#   make chaos-bench      the kill-under-load chaos storm gate (SIGKILL
+#                         worker replicas + scheduled thread-replica
+#                         ejections under live HTTP load: zero wrong
+#                         answers, bounded 429/503, auto-rejoin, zero
+#                         leaked threads/processes/fds) — refreshes
+#                         benchmarks/chaos_bench.json; the on-chip storm
+#                         rides benchmarks/tpu_queue.sh chaos_storm tenk_vertical
 
 PYTHON ?= python
 
@@ -55,5 +62,8 @@ obs-bench:
 tenk-bench:
 	$(PYTHON) benchmarks/tenk_bench.py --out benchmarks/tenk_bench.json
 
+chaos-bench:
+	$(PYTHON) benchmarks/chaos_bench.py --out benchmarks/chaos_bench.json
+
 .PHONY: lint lint-changed native tsan bench-multichip \
-	serve-bench-replicas obs-bench tenk-bench
+	serve-bench-replicas obs-bench tenk-bench chaos-bench

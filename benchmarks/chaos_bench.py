@@ -1,0 +1,413 @@
+"""Chaos storm gate: kill replicas under live HTTP load and prove the
+plane degrades honestly (fast 429/503, never a hang, never a wrong
+answer) and heals itself (ejected workers reboot and rejoin).
+
+Two storm arms, one per replica kind:
+
+- **process** — N worker-subprocess replicas behind the routing front;
+  a killer thread SIGKILLs a random live worker on a schedule while
+  closed-loop HTTP clients hammer ``/v1/predict``.  The per-request
+  deadline + typed ``ReplicaDeadError`` turn each kill into (at most)
+  one retried request; the background probe reboots the corpse and
+  rejoins it.
+- **thread** — N in-process replicas; the chaos schedule calls
+  ``router.eject()`` (in-process stacks cannot die separately from the
+  plane, so ejection IS their failure mode) and the probe rejoins them.
+
+Gates (asserted, and recorded in the committed
+``benchmarks/chaos_bench.json`` — ``make chaos-bench``):
+
+- **zero wrong answers**: every 200 body is byte-identical to the
+  healthy plane's answer (predictions are pure; a retried request must
+  reproduce them exactly).
+- **bounded error budget**: every non-200 is a fast 429/503 — no other
+  status, and no request's wall time past the stated deadline envelope.
+- **self-healing**: ejections AND rejoins both observed; full recovery
+  (every replica live) within the recovery envelope after the storm.
+- **zero leaks**: post-storm thread/child-process/fd census returns to
+  the pre-plane baseline (the plane starts lint-clean — RS001/RS002
+  prove the code SHAPE; this proves the runtime).
+
+Honest-CPU note: every replica shares one host core here, so
+throughput/latency numbers are plumbing proofs; worker reboot time is
+dominated by the child's jax import (~5-15 s cold).  The on-chip storm
+rides benchmarks/tpu_queue.sh (``chaos_storm`` step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+F, E, H, W = 6, 3, 8, 8
+
+
+def build_tiny(scale: float = 1.0, ladder=(8,), delay_s: float = 0.0):
+    """Factory for both the parent reference stack and the worker
+    subprocesses (spec ``factory: chaos_bench:build_tiny``).  A fixed
+    ``delay_s`` per predict gives the killer a window to land SIGKILLs
+    MID-request."""
+    import jax
+
+    from deeprest_tpu.config import ModelConfig
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.models.qrnn import QuantileGRU
+    from deeprest_tpu.serve import Predictor
+
+    mc = ModelConfig(feature_dim=F, num_metrics=E, hidden_size=H,
+                     dropout_rate=0.0)
+    model = QuantileGRU(config=mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, W, F), np.float32),
+                        deterministic=True)["params"]
+    if scale != 1.0:
+        params = jax.tree.map(lambda a: a * scale, params)
+    pred = Predictor(
+        params, mc,
+        x_stats=MinMaxStats(min=np.float32(0.0), max=np.float32(1.0)),
+        y_stats=MinMaxStats(min=np.zeros((E,), np.float32),
+                            max=np.ones((E,), np.float32)),
+        metric_names=[f"c{i}_cpu" for i in range(E)],
+        window_size=W, ladder=tuple(ladder))
+    if delay_s:
+        class _Slow:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def predict_series(self, traffic, integrate=True):
+                time.sleep(delay_s)
+                return self._inner.predict_series(traffic,
+                                                  integrate=integrate)
+
+            def predict_series_many(self, series_list, integrate=True):
+                time.sleep(delay_s)
+                return self._inner.predict_series_many(
+                    series_list, integrate=integrate)
+
+        return _Slow(pred)
+    return pred
+
+
+def _noop():
+    pass
+
+
+def _warm_multiprocessing() -> None:
+    """Start+reap one throwaway spawn process BEFORE any baseline
+    census: the first spawn in a process initializes one-time singletons
+    (the resource-tracker daemon and its pipe fd) that would otherwise
+    read as a storm 'leak' when they are process-lifetime machinery."""
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_noop)
+    p.start()
+    p.join(timeout=60)
+    try:
+        p.close()
+    except ValueError:
+        pass
+
+
+def _census() -> dict:
+    for _ in multiprocessing.active_children():   # reaps exited workers
+        pass
+    return {
+        "threads": threading.active_count(),
+        "children": len(multiprocessing.active_children()),
+        "fds": len(os.listdir("/proc/self/fd")),
+    }
+
+
+def _settled_census(baseline: dict, timeout_s: float = 15.0) -> dict:
+    """Post-storm census with a settle loop: batcher workers, HTTP
+    handler threads, and SIGCHLD reaping all finish asynchronously after
+    close() — poll until the counts return to baseline (or report the
+    stuck values)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        now = _census()
+        clean = (now["threads"] <= baseline["threads"]
+                 and now["children"] <= baseline["children"]
+                 and now["fds"] <= baseline["fds"])
+        if clean or time.monotonic() > deadline:
+            return {"before": baseline, "after": now, "clean": clean}
+        time.sleep(0.2)
+
+
+class _LoadStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.http_429 = 0
+        self.http_503 = 0
+        self.other_status = 0
+        self.wrong_answers = 0
+        self.walls: list[float] = []
+
+
+def _client_loop(address, payload, reference, stop, stats: _LoadStats):
+    import http.client
+
+    while not stop.is_set():
+        t0 = time.monotonic()
+        try:
+            conn = http.client.HTTPConnection(*address, timeout=120)
+            conn.request("POST", "/v1/predict", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            status = resp.status
+            conn.close()
+        except OSError:
+            # connection-level failure = the hang/drop class the gate
+            # forbids (the server must always answer)
+            status, body = -1, b""
+        wall = time.monotonic() - t0
+        with stats.lock:
+            stats.walls.append(wall)
+            if status == 200:
+                preds = json.loads(body)["predictions"]
+                if preds == reference:
+                    stats.ok += 1
+                else:
+                    stats.wrong_answers += 1
+            elif status == 429:
+                stats.http_429 += 1
+            elif status == 503:
+                stats.http_503 += 1
+            else:
+                stats.other_status += 1
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    k = min(len(sorted_vals) - 1,
+            int(round(p / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+def _await_recovery(router, n, timeout_s: float) -> float:
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while True:
+        stats = router.router_stats()
+        if stats["live_replicas"] == n:
+            return time.monotonic() - t0
+        if time.monotonic() > deadline:
+            return float("inf")
+        time.sleep(0.25)
+
+
+def _run_arm(kind: str, *, replicas: int, duration_s: float,
+             clients: int, chaos_interval_s: float, delay_s: float,
+             replica_timeout_s: float, recovery_envelope_s: float,
+             seed: int) -> dict:
+    from deeprest_tpu.serve import (
+        PredictionServer, PredictionService, ReplicaRouter, RouterConfig,
+    )
+    from deeprest_tpu.serve.replica import ProcessReplica
+
+    baseline = _census()
+    reference = build_tiny().predict_series(
+        np.random.default_rng(0).random((2 * W, F)).astype(np.float32))
+    traffic = np.random.default_rng(0).random((2 * W, F)).astype(
+        np.float32)
+    payload = json.dumps({"traffic": traffic.tolist()}).encode()
+    reference_json = json.loads(json.dumps(reference.tolist()))
+
+    cfg = RouterConfig(admission_depth=64,
+                       replica_timeout_s=replica_timeout_s,
+                       eject_after_failures=1, retry_budget=1,
+                       probe_interval_s=0.25)
+    if kind == "process":
+        spec = {"factory": "chaos_bench:build_tiny",
+                "kwargs": {"delay_s": delay_s, "ladder": [8]},
+                "sys_path": [os.path.dirname(os.path.abspath(__file__))]}
+        router = ReplicaRouter(
+            [ProcessReplica(spec, name=f"p{i}", boot_timeout_s=300.0,
+                            request_timeout_s=replica_timeout_s)
+             for i in range(replicas)], config=cfg)
+    else:
+        router = ReplicaRouter.build(build_tiny(delay_s=delay_s),
+                                     replicas, config=cfg)
+    service = PredictionService(router, None, backend=f"chaos-{kind}")
+    server = PredictionServer(service, port=0).start()
+
+    load_stop = threading.Event()
+    chaos_stop = threading.Event()
+    stats = _LoadStats()
+    rng = random.Random(seed)
+    threads = [threading.Thread(
+        target=_client_loop,
+        args=(server.address, payload, reference_json, load_stop, stats),
+        name=f"chaos-client-{i}") for i in range(clients)]
+
+    def chaos_loop():
+        while not chaos_stop.wait(chaos_interval_s):
+            victims = router.replicas
+            if not victims:
+                continue
+            victim = rng.choice(victims)
+            if kind == "process":
+                pid = victim.stats().get("pid")
+                if pid and victim.alive():
+                    os.kill(pid, signal.SIGKILL)
+            else:
+                try:
+                    router.eject(victim.name, reason="chaos schedule")
+                except KeyError:
+                    pass
+
+    chaos = threading.Thread(target=chaos_loop, name="chaos-killer")
+    for t in threads:
+        t.start()
+    # let the plane serve healthy traffic first (warmup + baseline 200s)
+    time.sleep(max(1.0, 3 * delay_s))
+    chaos.start()
+    time.sleep(duration_s)
+    # storm ends; load keeps flowing briefly through the RECOVERING
+    # plane (the interesting window), then drains
+    chaos_stop.set()
+    chaos.join(timeout=10)
+    time.sleep(1.0)
+    load_stop.set()
+    for t in threads:
+        t.join(timeout=180)
+    hung = [t.name for t in threads if t.is_alive()]
+
+    recovery_s = _await_recovery(router, replicas, recovery_envelope_s * 2)
+    health = router.router_stats()["health"]
+
+    # the healed plane answers byte-identically
+    final = service.predict({"traffic": traffic.tolist()})
+    final_ok = final["predictions"] == reference_json
+
+    server.stop()
+    leak = _settled_census(baseline)
+
+    with stats.lock:
+        walls = sorted(stats.walls)
+        total = (stats.ok + stats.http_429 + stats.http_503
+                 + stats.other_status + stats.wrong_answers)
+        envelope = replica_timeout_s + delay_s + 10.0
+        arm = {
+            "replicas": replicas,
+            "clients": clients,
+            "duration_s": duration_s,
+            "chaos_interval_s": chaos_interval_s,
+            "requests": total,
+            "ok": stats.ok,
+            "http_429": stats.http_429,
+            "http_503": stats.http_503,
+            "other_status": stats.other_status + len(hung),
+            "wrong_answers": stats.wrong_answers + (0 if final_ok else 1),
+            "max_request_wall_s": round(max(walls), 3) if walls else None,
+            "envelope_s": envelope,
+            "p50_ms": round(1e3 * _pct(walls, 50), 3) if walls else None,
+            "p99_ms": round(1e3 * _pct(walls, 99), 3) if walls else None,
+            "ejections": health["ejections"],
+            "retries": health["retries"],
+            "rejoins": health["rejoins"],
+            "recovery_s": (round(recovery_s, 3)
+                           if np.isfinite(recovery_s) else None),
+            "recovery_envelope_s": recovery_envelope_s,
+            "leak": leak,
+        }
+    arm["pass"] = bool(
+        arm["wrong_answers"] == 0
+        and arm["other_status"] == 0
+        and arm["ok"] >= 1
+        and arm["max_request_wall_s"] is not None
+        and arm["max_request_wall_s"] <= arm["envelope_s"]
+        and arm["ejections"] >= 1
+        and arm["rejoins"] >= 1
+        and arm["recovery_s"] is not None
+        and arm["recovery_s"] <= recovery_envelope_s
+        and leak["clean"])
+    return arm
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1-sized storm (fewer replicas, kills, "
+                         "seconds) — plumbing + gates, not endurance")
+    ap.add_argument("--arms", default="thread,process",
+                    help="comma list of storm arms to run")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    _warm_multiprocessing()
+    quick = bool(args.quick)
+    # recovery on CPU is dominated by the worker reboot's jax import
+    # (cold ~5-15 s; warm compile cache much less) — the envelope states
+    # that honestly rather than pretending chip-grade failover
+    recovery_envelope_s = 90.0
+    arms = {}
+    for kind in [a.strip() for a in args.arms.split(",") if a.strip()]:
+        if kind == "thread":
+            arms[kind] = _run_arm(
+                "thread",
+                replicas=2 if quick else 4,
+                duration_s=4.0 if quick else 20.0,
+                clients=3 if quick else 6,
+                chaos_interval_s=1.0 if quick else 2.0,
+                delay_s=0.05,
+                replica_timeout_s=15.0,
+                recovery_envelope_s=recovery_envelope_s,
+                seed=args.seed)
+        elif kind == "process":
+            arms[kind] = _run_arm(
+                "process",
+                replicas=2 if quick else 3,
+                duration_s=8.0 if quick else 30.0,
+                clients=3 if quick else 6,
+                chaos_interval_s=4.0 if quick else 6.0,
+                delay_s=0.3,
+                replica_timeout_s=20.0,
+                recovery_envelope_s=recovery_envelope_s,
+                seed=args.seed)
+        else:
+            ap.error(f"unknown arm {kind!r}")
+
+    result = {
+        "schema_version": 1,
+        "quick": quick,
+        "platform": jax.default_backend(),
+        "honest_cpu": (
+            "all replicas share one host core; worker reboot time is "
+            "dominated by the child's jax import — throughput/latency "
+            "cells are plumbing proofs, the gates (zero wrong answers, "
+            "bounded errors, rejoin, zero leaks) are the product"),
+        "arms": arms,
+        "pass": bool(arms) and all(a["pass"] for a in arms.values()),
+    }
+    blob = json.dumps(result, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(blob + "\n")
+    print(json.dumps(result, sort_keys=True))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

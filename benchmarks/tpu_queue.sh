@@ -100,6 +100,16 @@ step serve_bench_replicas 2400 env JAX_PLATFORMS=tpu python \
 # on-chip too.
 step tenk_vertical 2400 env JAX_PLATFORMS=tpu python \
   benchmarks/tenk_bench.py --out benchmarks/tenk_bench_tpu.json
+# Chaos storm on-chip (round 17): the committed CPU chaos_bench.json
+# proves the gates (zero wrong answers under SIGKILL, bounded 429/503,
+# auto-rejoin, zero leaked threads/processes/fds) where every replica
+# shares one host core; on hardware the interesting numbers are the
+# recovery time with a real chip behind the rebooted worker and the
+# storm p99 with replicas on distinct devices.  Thread arm runs on the
+# chip; worker subprocesses keep the CPU backend (two processes cannot
+# share one TPU chip — serve_bench's one-worker-per-host note applies).
+step chaos_storm 1800 env JAX_PLATFORMS=tpu python \
+  benchmarks/chaos_bench.py --out benchmarks/chaos_bench_tpu.json
 # Observability overhead on-chip (round 14): the committed CPU
 # obs_bench.json proves the <=3% budget where spans are a visible
 # fraction of a millisecond-scale call; on the accelerator, per-call

@@ -25,6 +25,12 @@ call graph and the path-sensitive paired-operation walker (core.py):
   are not a lifecycle guarantee (ref cycles, interpreter teardown, a
   replica killed mid-request never runs them); cleanup belongs in an
   explicit ``close()`` the owner calls.
+- RS004 — unbounded retry loops in the serving plane: a ``while True``
+  (or recursive) retry around a raise-capable call with neither an
+  attempt cap nor a backoff.  The chaos-hardened router retries dead
+  replicas BOUNDEDLY (``retry_budget``) and its probe loop is paced
+  (``probe_interval_s``); an unbounded retry busy-spins the host the
+  moment a dependency stays down — which, under chaos, is a certainty.
 """
 
 from __future__ import annotations
@@ -460,6 +466,156 @@ class RS002DrainWithoutResume(Rule):
                     "or suppress with a reason for a designed shutdown "
                     "sink")
                 break
+
+
+def _body_has(nodes, kinds) -> bool:
+    """Any node of ``kinds`` in the statements' subtrees, NOT descending
+    into nested function definitions (their control flow is their own)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, kinds):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+_BACKOFF_ATTRS = ("sleep", "wait")
+
+
+def _has_backoff(nodes) -> bool:
+    """A pacing call (time.sleep / Event.wait / Condition.wait / stop
+    .wait) anywhere in the statements, nested defs excluded."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            name = call_name(node.func)
+            if name is not None and name.split(".")[-1] in _BACKOFF_ATTRS:
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _has_cap_guard(fn: ast.AST) -> bool:
+    """An attempt-cap shape anywhere in the function: an ``if`` whose
+    test contains a comparison and whose body raises/returns/breaks —
+    ``if attempt >= budget: raise`` and friends."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        if not any(isinstance(s, ast.Compare) for s in ast.walk(node.test)):
+            continue
+        if _body_has(node.body, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+@register
+class RS004UnboundedRetry(Rule):
+    id = "RS004"
+    title = ("unbounded retry loop (while-True or recursive retry around "
+             "a raise-capable call with no attempt cap or backoff) in "
+             "the serve/ plane")
+    guards = ("round 17: the chaos-hardened router re-dispatches dead-"
+              "replica requests and the probe loop reboots ejected "
+              "workers — both retries are BOUNDED by design "
+              "(RouterConfig.retry_budget; probe_interval_s pacing).  A "
+              "retry loop with neither an attempt cap nor a backoff "
+              "turns one dead replica into a busy-spin that saturates "
+              "the host exactly when the plane is least healthy — and "
+              "under chaos every replica WILL die eventually, so the "
+              "spin is a certainty, not a tail risk")
+
+    # The serving plane, where retries meet live traffic.
+    HOT_DIRS = ("serve",)
+
+    def _is_hot(self, rel: str) -> bool:
+        parts = rel.replace("\\", "/").split("/")
+        return any(d in parts[:-1] for d in self.HOT_DIRS)
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None or not self._is_hot(sf.rel):
+                continue
+            for fn, _cls in _function_rel_functions(sf):
+                yield from self._check_while_retry(sf, fn)
+                yield from self._check_recursive_retry(sf, fn)
+
+    @staticmethod
+    def _is_forever(test: ast.AST) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value)
+
+    def _check_while_retry(self, sf: SourceFile,
+                           fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.While)
+                    and self._is_forever(node.test)):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.Try):
+                    continue
+                # a retry-continue handler neither re-raises nor leaves
+                # the loop: the exception is eaten and the loop respins
+                swallowing = [
+                    h for h in stmt.handlers
+                    if not _body_has(h.body,
+                                     (ast.Raise, ast.Return, ast.Break))
+                ]
+                if not swallowing:
+                    continue
+                # discharged by EITHER an attempt cap (a compare-guarded
+                # raise/break/return anywhere in the loop) or a backoff
+                # (a sleep/wait pacing the respin)
+                if _has_backoff(node.body) or any(_has_cap_guard(s)
+                                                  for s in node.body):
+                    continue
+                yield sf.finding(
+                    swallowing[0], self.id,
+                    "unbounded retry: this while-True loop swallows the "
+                    "exception and respins with no attempt cap and no "
+                    "backoff — one persistently-failing callee becomes "
+                    "a busy-spin; bound it (attempt counter + raise) or "
+                    "pace it (sleep/Event.wait), or suppress with a "
+                    "reason")
+                break
+
+    def _check_recursive_retry(self, sf: SourceFile,
+                               fn: ast.AST) -> Iterator[Finding]:
+        name = getattr(fn, "name", None)
+        if not name:
+            return
+        if _has_cap_guard(fn):
+            return                     # a compare-guarded raise = the cap
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if _has_backoff(h.body):
+                    continue
+                for stmt in h.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        cname = call_name(sub.func)
+                        if cname is not None and \
+                                cname.split(".")[-1] == name:
+                            yield sf.finding(
+                                sub, self.id,
+                                f"unbounded recursive retry: the "
+                                f"handler calls {name}() again with no "
+                                "attempt cap in sight — a persistently-"
+                                "failing callee recurses to the stack "
+                                "limit; thread an attempts parameter "
+                                "with a compare-guarded raise, or "
+                                "suppress with a reason")
+                            return
 
 
 @register

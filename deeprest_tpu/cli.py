@@ -315,7 +315,8 @@ def cmd_train(args) -> int:
                           grad_accum_windows=args.grad_accum_windows,
                           grad_accum_mode=args.grad_accum_mode,
                           sparse_feed=args.sparse_feed,
-                          sparse_nnz_cap=args.sparse_nnz_cap),
+                          sparse_nnz_cap=args.sparse_nnz_cap,
+                          snapshot_every_steps=args.snapshot_every_steps),
         mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
@@ -353,15 +354,35 @@ def cmd_train(args) -> int:
         if args.report_every and (result.epoch + 1) % args.report_every == 0:
             print(format_report(result.report), flush=True)
 
+    # Preemption-safe restarts: with --snapshot-every-steps and a cursor
+    # snapshot already on disk, re-running the SAME command resumes the
+    # killed run (plan replay, bit-identical to uninterrupted) instead of
+    # restarting from scratch — the operator's contract is simply "run it
+    # again".
+    resume = False
+    if args.snapshot_every_steps and args.ckpt_dir:
+        from deeprest_tpu.train.checkpoint import latest_cursor_step
+
+        resume = latest_cursor_step(args.ckpt_dir) is not None
+        if resume:
+            print(f"resuming preempted run from {args.ckpt_dir} "
+                  "(newest cursor snapshot)", flush=True)
     try:
-        state, history = trainer.fit(bundle, baseline_preds=baselines,
-                                     on_epoch=on_epoch)
+        if resume:
+            state, history = trainer.resume_training(
+                bundle, baseline_preds=baselines, on_epoch=on_epoch)
+        else:
+            state, history = trainer.fit(bundle, baseline_preds=baselines,
+                                         on_epoch=on_epoch)
     finally:
         # fit() may raise (or run zero epochs) before on_epoch could stop
         # the trace — flush it anyway: the failing run is exactly the one
         # worth profiling.
         stop_profiling()
-    print(format_report(history[-1].report))
+    if history:
+        print(format_report(history[-1].report))
+    else:
+        print("resume point is already past the final epoch; nothing to do")
     print(f"steady-state throughput: {trainer.throughput.steps_per_sec:.2f} steps/s")
 
     if args.plots_dir:
@@ -463,7 +484,8 @@ def cmd_stream(args) -> int:
                           grad_accum_windows=args.grad_accum_windows,
                           grad_accum_mode=args.grad_accum_mode,
                           sparse_feed=args.sparse_feed,
-                          sparse_nnz_cap=args.sparse_nnz_cap),
+                          sparse_nnz_cap=args.sparse_nnz_cap,
+                          snapshot_every_steps=args.snapshot_every_steps),
         etl=EtlConfig(overlap=not args.no_etl_overlap,
                       queue_depth=args.etl_queue_depth),
     )
@@ -724,11 +746,18 @@ def cmd_serve(args) -> int:
             weights = _parse_tenant_weights(args.tenant_weights)
         except ValueError as exc:
             sys.exit(f"error: {exc}")
+        if args.replica_timeout_ms < 0:
+            sys.exit(f"error: --replica-timeout-ms "
+                     f"{args.replica_timeout_ms} must be >= 0 (0 = none)")
         router_cfg = RouterConfig(
             admission_depth=args.admission_depth or 64,
             max_wait_s=args.admission_wait_ms / 1e3,
             retry_after_s=args.admission_retry_after_ms / 1e3,
-            tenant_weights=weights)
+            tenant_weights=weights,
+            replica_timeout_s=(args.replica_timeout_ms / 1e3
+                               if args.replica_timeout_ms else None),
+            eject_after_failures=args.eject_after_failures,
+            retry_budget=args.retry_budget)
         if args.replica_mode == "process":
             if not (args.ckpt_dir or args.artifact):
                 sys.exit("error: --replica-mode=process needs --ckpt-dir "
@@ -1184,6 +1213,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; 'flat' folds rows straight through the "
                         "kernel (max MXU row occupancy, ~1e-7 grad "
                         "reassociation); 'loop' is the unfused reference")
+    p.add_argument("--snapshot-every-steps", type=int, default=0,
+                   metavar="N",
+                   help="preemption-safe training: atomically checkpoint "
+                        "the full state PLUS the epoch-plan cursor "
+                        "(epoch, step offset, shuffle-rng state) into "
+                        "--ckpt-dir every N real steps; re-running the "
+                        "same command after a kill resumes the run — "
+                        "onto whatever mesh remains — bit-identical to "
+                        "an uninterrupted run at the same step (0 = off)")
     _add_sparse_args(p)
     _add_mesh_arg(p)
     p.add_argument("--ckpt-dir", default=None)
@@ -1258,6 +1296,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop; 'flat' folds rows straight through the "
                         "kernel (max MXU row occupancy, ~1e-7 grad "
                         "reassociation); 'loop' is the unfused reference")
+    p.add_argument("--snapshot-every-steps", type=int, default=0,
+                   metavar="N",
+                   help="preemption-safe fine-tuning: checkpoint the full "
+                        "state + stream sidecar (frozen metric set, "
+                        "stats, refresh counter, retained-ring "
+                        "watermarks) every N fine-tune steps, so a "
+                        "stream killed MID-refresh resumes at most N "
+                        "steps stale instead of losing the refresh "
+                        "(0 = off; refresh-end checkpoints always "
+                        "happen)")
     _add_sparse_args(p)
     p.add_argument("--refresh-buckets", type=int, default=60,
                    help="fine-tune after this many new buckets")
@@ -1380,6 +1428,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tenant-weights", default=None, metavar="a=3,b=1",
                    help="weighted round-robin shares per X-Tenant header "
                         "value (unknown tenants weigh 1)")
+    p.add_argument("--replica-timeout-ms", type=float, default=30000.0,
+                   metavar="MS",
+                   help="per-request deadline on process replicas: a "
+                        "worker dead between heartbeats becomes a typed "
+                        "ReplicaDeadError instead of an indefinite pipe "
+                        "recv (0 = no deadline — the historical hang)")
+    p.add_argument("--eject-after-failures", type=int, default=3,
+                   metavar="N",
+                   help="consecutive dead-replica failures that eject a "
+                        "replica from dispatch (a confirmed-dead worker "
+                        "ejects immediately); the background probe "
+                        "reboots process replicas and rejoins them")
+    p.add_argument("--retry-budget", type=int, default=1, metavar="N",
+                   help="max re-dispatches of ONE request onto survivor "
+                        "replicas — only for failures proving the "
+                        "request never produced a response (worker dead "
+                        "/ send failed); deadline expiries on a live "
+                        "worker are never retried (no double-execution)")
     p.add_argument("--autoscale", action="store_true",
                    help="run the self-sizing control loop "
                         "(deploy/autoscaler.py): observed traffic -> "

@@ -159,6 +159,18 @@ class TrainConfig:
     # vector).  Also the padded-COO row width, so it sizes both ring
     # memory and feed bytes.
     sparse_nnz_cap: int = 64
+    # Preemption-safe training (ROADMAP item 7, dynamic half): every this
+    # many REAL train steps (superstep path: at the first chunk boundary
+    # at or past the cadence) the trainer writes an atomic
+    # deeprest-sharded-v1 checkpoint PLUS the epoch-plan cursor (epoch
+    # index, steps done within the epoch, the shuffle rng's bit-generator
+    # state at epoch start, global step) into the sidecar.  A killed run
+    # restarts via ``Trainer.resume_training`` — onto whatever mesh
+    # remains (cross-mesh restore) — replays the plan from the cursor,
+    # and is bit-identical to the uninterrupted run at the same step
+    # (tests/test_chaos.py).  0 = off (the historical behavior; epoch-
+    # cadence checkpoints only).
+    snapshot_every_steps: int = 0
 
     def __post_init__(self):
         v = self.steps_per_superstep
@@ -182,6 +194,11 @@ class TrainConfig:
             raise ValueError(
                 f"TrainConfig.sparse_nnz_cap={self.sparse_nnz_cap!r}: "
                 f"must be an int >= 1")
+        s = self.snapshot_every_steps
+        if not isinstance(s, int) or isinstance(s, bool) or s < 0:
+            raise ValueError(
+                f"TrainConfig.snapshot_every_steps={s!r}: must be an "
+                f"int >= 0 (0 = snapshots off)")
         if self.sparse_feed and self.device_data == "off":
             raise ValueError(
                 "TrainConfig.sparse_feed=True requires the staged "

@@ -16,7 +16,7 @@ from deeprest_tpu.serve.server import (
     CheckpointReloader, PredictionServer, PredictionService, ServingError,
 )
 from deeprest_tpu.serve.replica import (
-    EngineReplica, ProcessReplica, clone_backend,
+    EngineReplica, ProcessReplica, ReplicaDeadError, clone_backend,
 )
 from deeprest_tpu.serve.router import (
     AdmissionError, ReplicaRouter, RouterConfig,
@@ -41,6 +41,7 @@ __all__ = [
     "ServingError",
     "EngineReplica",
     "ProcessReplica",
+    "ReplicaDeadError",
     "clone_backend",
     "AdmissionError",
     "ReplicaRouter",

@@ -47,6 +47,40 @@ from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.obs import spans as obs_spans
 
 
+class ReplicaDeadError(RuntimeError):
+    """A replica cannot answer this request: its worker died, its pipe
+    broke, or it blew through the per-request deadline.
+
+    ``retriable`` encodes the router's no-double-execution safety rule
+    (serve/router.py): True only when the failure PROVES no response was
+    or ever will be produced — the send never reached the worker, or the
+    worker process is dead (its device state died with it, so the work
+    cannot complete elsewhere-visibly).  A deadline expiry on a LIVE
+    worker is retriable=False: the request may still be executing on the
+    device, and re-dispatching it would double-execute — the router
+    ejects the replica and answers a fast 503 instead.
+    """
+
+    def __init__(self, message: str, replica: str = "",
+                 retriable: bool = False):
+        super().__init__(message)
+        self.replica = replica
+        self.retriable = retriable
+
+
+def _release_proc(proc) -> None:
+    """Free a reaped worker's parent-side resources NOW (the Popen
+    sentinel pipe fd otherwise lives until garbage collection — the
+    chaos harness's post-storm fd census counts exactly such strays).
+    No-op while the process is still running."""
+    if proc is None or proc.is_alive():
+        return
+    try:
+        proc.close()
+    except ValueError:
+        pass        # already closed / never started
+
+
 def _num_windows(t: int, w: int) -> int:
     """Window count of a [T, F] series under the serving tiling (regular
     stride-W tiling + right-aligned ragged tail) — the router's
@@ -470,12 +504,18 @@ class ProcessReplica:
     kind = "process"
 
     def __init__(self, spec: dict, name: str = "p0",
-                 boot_timeout_s: float = 120.0):
+                 boot_timeout_s: float = 120.0,
+                 request_timeout_s: float | None = None):
         from concurrent.futures import Future
 
         self.name = name
         self.device = None             # the child owns its device binding
         self.spec = dict(spec)
+        # Per-request deadline (None = the historical indefinite wait).
+        # Without it a worker that dies mid-request BETWEEN heartbeats
+        # wedges its caller forever on the response future — the bug the
+        # router's ejection path consumes as a typed ReplicaDeadError.
+        self.request_timeout_s = request_timeout_s
         # The child mirrors the parent's span-recording state at boot
         # (an explicit spec["obs"] wins — tests pin both modes).
         self.spec.setdefault("obs", obs_spans.RECORDER.enabled)
@@ -535,6 +575,7 @@ class ProcessReplica:
                 if proc.is_alive():
                     proc.terminate()
                 proc.join(timeout=5)
+                _release_proc(proc)
             raise
         with self._lock:
             self._conn = conn
@@ -561,6 +602,20 @@ class ProcessReplica:
         with self._lock:
             return not (self._draining or self._closed)
 
+    def alive(self) -> bool:
+        """Is the worker subprocess running?  The router's health layer
+        reads this to pick between retry (dead ⇒ the request provably
+        has no surviving execution) and eject-without-retry (alive but
+        wedged ⇒ possible double-execution)."""
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            return False
+        try:
+            return proc.is_alive()
+        except ValueError:
+            return False       # reaped and released (close()/restart())
+
     # -- request multiplexing -------------------------------------------
 
     def _read_loop(self, conn) -> None:
@@ -579,8 +634,12 @@ class ProcessReplica:
                     if not stale:
                         self._futures.clear()
                 for f in pending:
-                    f.set_exception(RuntimeError(
-                        f"replica {self.name}: worker exited"))
+                    # Worker death proves no response will ever come and
+                    # its device state died with it — retriable: the
+                    # router may re-dispatch these to a survivor.
+                    f.set_exception(ReplicaDeadError(
+                        f"replica {self.name}: worker exited "
+                        "mid-request", replica=self.name, retriable=True))
                 return
             if req_id == "__spans__":
                 if ok:
@@ -597,6 +656,7 @@ class ProcessReplica:
 
     def _call(self, method: str, args, windows: int, requests: int = 1):
         from concurrent.futures import Future
+        from concurrent.futures import TimeoutError as FutureTimeout
 
         fut = Future()
         with self._lock:
@@ -611,9 +671,36 @@ class ProcessReplica:
             # the propagated trace context rides in the request tuple, so
             # the child's spans join this request's trace
             ctx = obs_spans.current_context()
-            with self._send_lock:
-                conn.send((req_id, method, args, ctx))
-            out = fut.result()
+            try:
+                with self._send_lock:
+                    conn.send((req_id, method, args, ctx))
+            except (OSError, BrokenPipeError, ValueError) as exc:
+                # the request never reached the worker: provably safe to
+                # re-dispatch on a survivor
+                with self._lock:
+                    self._futures.pop(req_id, None)
+                raise ReplicaDeadError(
+                    f"replica {self.name}: request send failed ({exc})",
+                    replica=self.name, retriable=True) from exc
+            try:
+                out = fut.result(timeout=self.request_timeout_s)
+            except FutureTimeout:
+                # Deadline blown.  Withdraw the future so a late answer
+                # is dropped (the reader treats unknown ids as stale).
+                # Retriability hinges on worker liveness: a DEAD worker
+                # cannot be mid-execution — safe to retry; a live one may
+                # still be running the request on its device, so a retry
+                # would double-execute (the router ejects + 503s).
+                with self._lock:
+                    self._futures.pop(req_id, None)
+                dead = not self.alive()
+                why = ("worker dead" if dead else
+                       "worker alive — not retried, the request may "
+                       "still be executing")
+                raise ReplicaDeadError(
+                    f"replica {self.name}: no response within "
+                    f"{self.request_timeout_s:.3f}s ({why})",
+                    replica=self.name, retriable=dead) from None
         finally:
             with self._cv:
                 self._outstanding -= windows
@@ -667,17 +754,44 @@ class ProcessReplica:
         stack from the spec — ``fresh`` is only the reload trigger, since
         the child loads the newest checkpoint step itself.  The caller
         (router) has drained this replica, so no request is in flight."""
+        self.restart()
+
+    def restart(self) -> None:
+        """Reboot the worker: new process/pipe/reader generation from the
+        same spec.  Works on a HEALTHY drained worker (rolling reload)
+        and on a dead or wedged one (the router's probe-and-rejoin path
+        after an ejection — a SIGKILLed worker reboots here).  Any
+        requests still pending against the old generation fail with a
+        retriable ReplicaDeadError first, so no caller is left holding a
+        future the new worker will never answer."""
         with self._lock:
+            if self._closed:
+                raise RuntimeError(f"replica {self.name} is closed")
             old_conn, old_proc = self._conn, self._proc
-        self._boot()                   # new pipe/process/reader generation
+            orphans = list(self._futures.values())
+            self._futures.clear()
+        for f in orphans:
+            f.set_exception(ReplicaDeadError(
+                f"replica {self.name}: worker restarted mid-request",
+                replica=self.name, retriable=True))
         try:
-            old_conn.send(None)
-        except (OSError, BrokenPipeError):
-            pass
-        old_conn.close()               # old reader exits on EOF
-        old_proc.join(timeout=10)
-        if old_proc.is_alive():
-            old_proc.terminate()
+            self._boot()               # new pipe/process/reader generation
+        finally:
+            # reap the old generation even when the fresh boot fails (the
+            # router's probe will retry the restart; the dead worker and
+            # its pipe end must not outlive this attempt)
+            if old_conn is not None:
+                try:
+                    old_conn.send(None)
+                except (OSError, BrokenPipeError):
+                    pass
+                old_conn.close()       # old reader exits on EOF
+            if old_proc is not None:
+                old_proc.join(timeout=10)
+                if old_proc.is_alive():
+                    old_proc.terminate()
+                    old_proc.join(timeout=5)
+                _release_proc(old_proc)
 
     def set_batching(self, config) -> None:
         """Batching lives inside the worker's own stack: record the knob
@@ -710,14 +824,23 @@ class ProcessReplica:
         if proc is not None:
             proc.join(timeout=10)
             if proc.is_alive():
+                # a handler may still be mid-predict (the shutdown
+                # sentinel only stops the recv loop); reap the SIGTERM
+                # so close() returns with the worker actually gone
                 proc.terminate()
+                proc.join(timeout=5)
+            _release_proc(proc)
 
     def stats(self) -> dict:
         with self._lock:
+            try:
+                pid = self._proc.pid if self._proc is not None else None
+            except ValueError:
+                pid = None     # reaped and released (close())
             return {
                 "name": self.name,
                 "kind": self.kind,
-                "pid": self._proc.pid if self._proc is not None else None,
+                "pid": pid,
                 "outstanding_windows": self._outstanding,
                 "served_requests": self.served_requests(),
                 "served_windows": self.served_windows(),

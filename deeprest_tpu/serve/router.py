@@ -43,7 +43,9 @@ import numpy as np
 
 from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.obs import spans as obs_spans
-from deeprest_tpu.serve.replica import EngineReplica, clone_backend
+from deeprest_tpu.serve.replica import (
+    EngineReplica, ReplicaDeadError, clone_backend,
+)
 from deeprest_tpu.serve.server import ServingError
 
 
@@ -58,13 +60,27 @@ class AdmissionError(ServingError):
 
 @dataclasses.dataclass(frozen=True)
 class RouterConfig:
-    """Admission/fairness knobs for :class:`ReplicaRouter`.
+    """Admission/fairness/health knobs for :class:`ReplicaRouter`.
 
     ``admission_depth`` bounds concurrently ADMITTED requests across the
     whole plane; ``max_waiting`` bounds the short fairness queue behind it
     (everything beyond fails fast).  ``max_wait_s`` is how long a request
     may sit in that queue before it too turns into a 429 — the knob that
     keeps p99 bounded instead of collapsing under overload.
+
+    The health knobs are the dynamic half of ROADMAP item 7:
+    ``replica_timeout_s`` is the per-request deadline handed to process
+    replicas (a worker dead between heartbeats turns into a typed
+    ``ReplicaDeadError`` instead of an indefinite ``recv``);
+    ``eject_after_failures`` consecutive dead-replica failures eject the
+    replica from dispatch; ``retry_budget`` bounds how many times one
+    request may be re-dispatched onto survivors (and ONLY for failures
+    that prove the request never produced — and can never produce — a
+    response: worker dead or send failed.  A deadline expiry on a live
+    worker is never retried: the work may still be executing, and
+    re-running it would double-execute); ``probe_interval_s`` paces the
+    background probe that reboots ejected process replicas (reload-by-
+    restart) and rejoins them.
     """
 
     admission_depth: int = 64
@@ -73,6 +89,10 @@ class RouterConfig:
     retry_after_s: float = 0.05
     tenant_weights: dict[str, float] | None = None
     default_tenant: str = "default"
+    replica_timeout_s: float | None = 30.0
+    eject_after_failures: int = 3
+    retry_budget: int = 1
+    probe_interval_s: float = 0.5
 
     def __post_init__(self):
         if self.admission_depth < 1:
@@ -85,11 +105,36 @@ class RouterConfig:
         for t, w in (self.tenant_weights or {}).items():
             if w <= 0:
                 raise ValueError(f"tenant {t!r} weight {w} must be > 0")
+        if self.replica_timeout_s is not None and self.replica_timeout_s <= 0:
+            raise ValueError(
+                f"replica_timeout_s {self.replica_timeout_s} must be > 0 "
+                "(None = no deadline)")
+        if self.eject_after_failures < 1:
+            raise ValueError(f"eject_after_failures "
+                             f"{self.eject_after_failures} must be >= 1")
+        if self.retry_budget < 0:
+            raise ValueError(f"retry_budget {self.retry_budget} "
+                             "must be >= 0")
+        if self.probe_interval_s <= 0:
+            raise ValueError(f"probe_interval_s {self.probe_interval_s} "
+                             "must be > 0")
 
     @property
     def waiting_bound(self) -> int:
         return (self.admission_depth if self.max_waiting is None
                 else self.max_waiting)
+
+
+@dataclasses.dataclass
+class _ReplicaHealth:
+    """Per-replica health the router tracks across dispatches (all
+    mutations under the router lock)."""
+
+    consecutive_failures: int = 0
+    ejected: bool = False
+    ejections: int = 0
+    rejoins: int = 0
+    last_error: str | None = None
 
 
 class _Waiter:
@@ -301,6 +346,28 @@ class ReplicaRouter:
         self._dispatched = 0
         self._batching = batching
         self._autoscaler_decision: dict | None = None
+        # Per-replica health (keyed by object identity — names recycle
+        # across scale_to generations) + the probe-and-rejoin thread.
+        # The probe starts lazily at the first ejection and parks itself
+        # once every replica is live again.
+        self._health: dict[int, _ReplicaHealth] = {}
+        self._probe_thread: threading.Thread | None = None
+        self._probe_stop = threading.Event()
+        self._closed = False
+        self._m_ejections = obs_metrics.Counter(
+            "deeprest_router_ejections_total",
+            "replicas ejected from dispatch by the health layer",
+            labelnames=("replica",))
+        self._m_retries = obs_metrics.Counter(
+            "deeprest_router_retries_total",
+            "requests re-dispatched onto a survivor after a dead replica",
+            labelnames=("replica",))
+        self._m_rejoins = obs_metrics.Counter(
+            "deeprest_router_rejoins_total",
+            "ejected replicas probed healthy and re-admitted to dispatch",
+            labelnames=("replica",))
+        for m in (self._m_ejections, self._m_retries, self._m_rejoins):
+            obs_metrics.REGISTRY.expose(m)
         self._meta = self._probe_meta(replicas[0])
         # Render-time /metrics view over the replica plane: everything it
         # publishes is already counted by the replicas' and admission's
@@ -381,6 +448,7 @@ class ReplicaRouter:
 
         if n < 1:
             raise ValueError(f"replica count {n} must be >= 1")
+        config = config or RouterConfig()
         if batching is not None:
             spec = dict(spec)
             spec["batching"] = {"max_batch": batching.max_batch,
@@ -389,7 +457,9 @@ class ReplicaRouter:
         replicas = []
         try:
             for i in range(n):
-                replicas.append(ProcessReplica(spec, name=f"p{i}"))
+                replicas.append(ProcessReplica(
+                    spec, name=f"p{i}",
+                    request_timeout_s=config.replica_timeout_s))
         except Exception:
             # a failing Nth boot must not leak the N-1 live workers
             for r in replicas:
@@ -442,13 +512,30 @@ class ReplicaRouter:
         """The PredictionService admission hook (fast 429 on overload)."""
         return self.admission.try_acquire(tenant)
 
-    def _pick(self):
-        """Least-outstanding-work replica (ties: round-robin), waiting
-        briefly through a rolling reload's drain gap."""
+    def _health_locked(self, replica) -> _ReplicaHealth:
+        """The replica's health record (caller holds ``self._lock``)."""
+        h = self._health.get(id(replica))
+        if h is None:
+            h = self._health[id(replica)] = _ReplicaHealth()
+        return h
+
+    def _pick(self, excluded: frozenset = frozenset()):
+        """Least-outstanding-work LIVE replica (ties: round-robin),
+        skipping ejected replicas and this request's ``excluded`` set
+        (replicas that already failed it).  Waits briefly only through a
+        rolling reload's drain gap — a plane whose every candidate is
+        ejected or excluded sheds FAST with a 503 instead of hanging
+        (ejections heal through the probe, seconds away; making the
+        request wait for that is exactly the unbounded-latency failure
+        the chaos gate forbids)."""
         deadline = time.monotonic() + 5.0
         while True:
             with self._lock:
-                live = [r for r in self._replicas if r.available()]
+                candidates = [r for r in self._replicas
+                              if id(r) not in excluded]
+                live = [r for r in candidates
+                        if r.available()
+                        and not self._health_locked(r).ejected]
                 if live:
                     self._rr += 1
                     best = min(
@@ -457,28 +544,191 @@ class ReplicaRouter:
                                        (i - self._rr) % len(live)))
                     self._dispatched += 1
                     return live[best]
-            if time.monotonic() > deadline:
+                # a DRAINING (non-ejected) candidate is a reload gap —
+                # sub-second by design, worth a bounded wait; but never
+                # wait when this request already burned a replica
+                recoverable = not excluded and any(
+                    not self._health_locked(r).ejected
+                    for r in candidates)
+            if not recoverable or time.monotonic() > deadline:
                 raise ServingError(
-                    "no live replica (plane reloading or shut down)",
-                    status=503)
+                    "no live replica (plane reloading, replicas ejected, "
+                    "or shut down)", status=503)
             time.sleep(0.005)
+
+    def _dispatch(self, call, tags: dict):
+        """One request through the health layer: dispatch, and on a typed
+        ReplicaDeadError note the failure (possibly ejecting the replica)
+        and — ONLY when the error proves the request never produced and
+        can never produce a response (worker dead / send failed, never a
+        deadline expiry on a live worker: that work may still be
+        executing and a re-run would double-execute) — re-dispatch onto
+        a survivor, at most ``retry_budget`` times.  Every other
+        exception is a request-level error and propagates untouched."""
+        cfg = self.config
+        excluded: set[int] = set()
+        retries = 0
+        while True:
+            replica = self._pick(frozenset(excluded))
+            try:
+                with obs_spans.RECORDER.span(
+                        "router.dispatch",
+                        component="deeprest-router") as sp:
+                    sp.tag(replica=replica.name, **tags)
+                    if retries:
+                        sp.tag(retry=retries)
+                    out = call(replica)
+            except ReplicaDeadError as exc:
+                self._note_replica_failure(replica, exc)
+                excluded.add(id(replica))
+                if not exc.retriable:
+                    raise ServingError(
+                        f"replica {replica.name} failed mid-request and "
+                        f"the request may still be executing ({exc}); "
+                        "not retried — no double-execution", status=503,
+                    ) from exc
+                if retries >= cfg.retry_budget:
+                    raise ServingError(
+                        f"request failed on {retries + 1} replica(s), "
+                        f"retry budget {cfg.retry_budget} exhausted "
+                        f"({exc})", status=503) from exc
+                retries += 1
+                self._m_retries.inc(replica=replica.name)
+                with obs_spans.RECORDER.span(
+                        "router.retry",
+                        component="deeprest-router") as sp:
+                    sp.tag(replica=replica.name, attempt=retries)
+                continue
+            self._note_replica_ok(replica)
+            return out
 
     def predict_series(self, traffic: np.ndarray,
                        integrate: bool = True) -> np.ndarray:
-        replica = self._pick()
-        with obs_spans.RECORDER.span("router.dispatch",
-                                     component="deeprest-router") as sp:
-            sp.tag(replica=replica.name, series=1)
-            return replica.predict_series(traffic, integrate=integrate)
+        return self._dispatch(
+            lambda r: r.predict_series(traffic, integrate=integrate),
+            {"series": 1})
 
     def predict_series_many(self, series_list, integrate: bool = True):
-        replica = self._pick()
         series_list = list(series_list)
-        with obs_spans.RECORDER.span("router.dispatch",
+        return self._dispatch(
+            lambda r: r.predict_series_many(series_list,
+                                            integrate=integrate),
+            {"series": len(series_list)})
+
+    # -- replica health: ejection, retry, probe-and-rejoin ---------------
+
+    def _note_replica_ok(self, replica) -> None:
+        with self._lock:
+            h = self._health.get(id(replica))
+            if h is not None and h.consecutive_failures:
+                h.consecutive_failures = 0
+
+    def _replica_alive(self, replica) -> bool:
+        alive = getattr(replica, "alive", None)
+        return alive() if callable(alive) else True
+
+    def _note_replica_failure(self, replica, exc) -> None:
+        dead = not self._replica_alive(replica)
+        with self._lock:
+            h = self._health_locked(replica)
+            h.consecutive_failures += 1
+            h.last_error = str(exc)
+            fails = h.consecutive_failures
+            eject = (not h.ejected
+                     and (dead or fails >= self.config.eject_after_failures))
+            if eject:
+                h.ejected = True
+                h.ejections += 1
+        if eject:
+            self._m_ejections.inc(replica=replica.name)
+            with obs_spans.RECORDER.span("router.eject",
+                                         component="deeprest-router") as sp:
+                sp.tag(replica=replica.name, dead=dead,
+                       consecutive_failures=fails, error=str(exc)[:200])
+            self._ensure_probe()
+
+    def eject(self, name: str, reason: str = "manual eject") -> None:
+        """Administratively eject a replica from dispatch (the chaos
+        harness's thread-replica kill switch; process replicas normally
+        eject themselves through ReplicaDeadError).  In-flight work on
+        the replica finishes; the probe rejoins it."""
+        with self._lock:
+            target = next((r for r in self._replicas if r.name == name),
+                          None)
+            if target is None:
+                raise KeyError(f"no replica named {name!r}")
+            h = self._health_locked(target)
+            fresh = not h.ejected
+            if fresh:
+                h.ejected = True
+                h.ejections += 1
+                h.last_error = reason
+        if fresh:
+            self._m_ejections.inc(replica=name)
+            with obs_spans.RECORDER.span("router.eject",
+                                         component="deeprest-router") as sp:
+                sp.tag(replica=name, reason=reason)
+            self._ensure_probe()
+
+    def _ensure_probe(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if (self._probe_thread is not None
+                    and self._probe_thread.is_alive()):
+                return
+            self._probe_stop = threading.Event()
+            stop = self._probe_stop
+            t = threading.Thread(target=self._probe_loop, args=(stop,),
+                                 daemon=True,
+                                 name="deeprest-router-probe")
+            self._probe_thread = t
+        t.start()
+
+    def _probe_loop(self, stop: threading.Event) -> None:
+        """Background probe-and-rejoin: each tick tries to bring every
+        ejected replica back — process replicas REBOOT via the existing
+        reload-by-restart (a SIGKILLed worker comes back as a fresh
+        spawn from the same spec), thread replicas rejoin directly
+        (in-process stacks cannot die separately from the plane; their
+        ejections are administrative or transient).  A replica whose
+        reboot fails stays ejected and is retried next tick — the tick
+        interval is the backoff (graftlint RS004's discharge).  The
+        thread parks once every replica is live; the next ejection
+        starts a fresh one."""
+        while not stop.wait(self.config.probe_interval_s):
+            with self._lock:
+                targets = [r for r in self._replicas
+                           if self._health_locked(r).ejected]
+            for r in targets:
+                if stop.is_set():
+                    return
+                try:
+                    self._revive(r)
+                except Exception as exc:
+                    with self._lock:
+                        self._health_locked(r).last_error = \
+                            f"rejoin failed: {exc}"
+            with self._lock:
+                if not any(self._health_locked(r).ejected
+                           for r in self._replicas):
+                    return              # park until the next ejection
+
+    def _revive(self, replica) -> None:
+        restart = getattr(replica, "restart", None)
+        if callable(restart):
+            restart()       # reboot-by-restart; raises when the boot fails
+        with self._lock:
+            h = self._health_locked(replica)
+            if not h.ejected:
+                return
+            h.ejected = False
+            h.consecutive_failures = 0
+            h.rejoins += 1
+        self._m_rejoins.inc(replica=replica.name)
+        with obs_spans.RECORDER.span("router.rejoin",
                                      component="deeprest-router") as sp:
-            sp.tag(replica=replica.name, series=len(series_list))
-            return replica.predict_series_many(series_list,
-                                               integrate=integrate)
+            sp.tag(replica=replica.name)
 
     # -- replica plane management ----------------------------------------
 
@@ -611,7 +861,9 @@ class ReplicaRouter:
             fresh = []
             try:
                 for i in range(len(replicas), n):
-                    fresh.append(ProcessReplica(lead.spec, name=f"p{i}"))
+                    fresh.append(ProcessReplica(
+                        lead.spec, name=f"p{i}",
+                        request_timeout_s=self.config.replica_timeout_s))
             except Exception:
                 # a failing Nth boot must not leak the N-1 workers
                 # already spawned (their subprocesses outlive the call)
@@ -629,7 +881,12 @@ class ReplicaRouter:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             replicas = list(self._replicas)
+            probe, stop = self._probe_thread, self._probe_stop
+        stop.set()
+        if probe is not None:
+            probe.join(timeout=5)
         seen = set()
         for r in replicas:
             backend = getattr(r, "backend", None)
@@ -667,6 +924,12 @@ class ReplicaRouter:
                      help="requests dispatched by the router")
         sink.counter("deeprest_router_rolling_reloads_total", reloads,
                      help="zero-downtime rolling reloads completed")
+        with self._lock:
+            ejected = sum(1 for r in replicas
+                          if self._health_locked(r).ejected)
+        sink.gauge("deeprest_router_ejected_replicas", ejected,
+                   help="replicas currently ejected from dispatch "
+                        "(awaiting probe-and-rejoin)")
         for r in replicas:
             labels = {"replica": r.name}
             sink.gauge("deeprest_replica_outstanding_windows",
@@ -690,18 +953,47 @@ class ReplicaRouter:
             sink.gauge("deeprest_plane_jit_executables", cache,
                        help="compiled executables across distinct stacks")
 
+    def health_totals(self) -> dict[str, int]:
+        """Cumulative ejection/retry/rejoin counts off the obs counters
+        (one source of truth with /metrics and the chaos gate)."""
+        return {
+            "ejections": int(sum(self._m_ejections.series().values())),
+            "retries": int(sum(self._m_retries.series().values())),
+            "rejoins": int(sum(self._m_rejoins.series().values())),
+        }
+
     def router_stats(self) -> dict:
         with self._lock:
             replicas = list(self._replicas)
             reloads = self._reloads
             dispatched = self._dispatched
             decision = self._autoscaler_decision
+            health = {
+                id(r): dataclasses.replace(self._health_locked(r))
+                for r in replicas
+            }
+        entries = []
+        for r in replicas:
+            s = r.stats()
+            h = health[id(r)]
+            s["health"] = {
+                "ejected": h.ejected,
+                "consecutive_failures": h.consecutive_failures,
+                "ejections": h.ejections,
+                "rejoins": h.rejoins,
+                "last_error": h.last_error,
+            }
+            entries.append(s)
         return {
-            "replicas": [r.stats() for r in replicas],
+            "replicas": entries,
             "num_replicas": len(replicas),
+            "live_replicas": sum(
+                1 for r in replicas
+                if r.available() and not health[id(r)].ejected),
             "dispatched": dispatched,
             "rolling_reloads": reloads,
             "admission": self.admission.stats(),
+            "health": self.health_totals(),
             "autoscaler": decision,
         }
 

@@ -3,7 +3,9 @@
 from deeprest_tpu.train.data import DatasetBundle, prepare_dataset
 from deeprest_tpu.train.trainer import Trainer, TrainState
 from deeprest_tpu.train.metrics import mae_report, format_report, Throughput
-from deeprest_tpu.train.checkpoint import save_checkpoint, restore_checkpoint, latest_step
+from deeprest_tpu.train.checkpoint import (
+    latest_cursor_step, latest_step, restore_checkpoint, save_checkpoint,
+)
 
 __all__ = [
     "DatasetBundle",
@@ -16,4 +18,5 @@ __all__ = [
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",
+    "latest_cursor_step",
 ]

@@ -71,6 +71,36 @@ def _shard_file(leaf_ord: int, name: str, lo: Sequence[int]) -> str:
     return f"{leaf_ord:03d}.{safe}.{start}.npy"
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit a directory's entries (the rename itself is only
+    durable once the PARENT directory is synced)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_json_durable(path: str, obj: Any, **dump_kwargs) -> None:
+    """json.dump + flush + fsync: the manifest/sidecar bytes must be on
+    the platter BEFORE the step directory's atomic rename publishes them
+    — a host crash after the rename but before writeback would otherwise
+    leave a published manifest full of zeros pointing at shard files
+    that never hit disk."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, **dump_kwargs)
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def _to_storage(arr: np.ndarray) -> tuple[np.ndarray, str]:
     """(.npy-safe array, recorded dtype name).  bf16 & friends go to disk
     as a same-width integer view."""
@@ -150,7 +180,9 @@ def save_checkpoint(directory: str, state: Any, step: int,
                 data = np.ascontiguousarray(data)
             stored, dtype_name = _to_storage(data)
             fname = _shard_file(ord_, name, [lo for lo, _ in idx])
-            np.save(os.path.join(arrays_dir, fname), stored)
+            fpath = os.path.join(arrays_dir, fname)
+            np.save(fpath, stored)
+            _fsync_file(fpath)
         if dtype_name is None:       # no local shard: dtype from metadata
             dtype_name = np.dtype(leaf.dtype).name
         for e in entries:
@@ -166,20 +198,27 @@ def save_checkpoint(directory: str, state: Any, step: int,
         multihost_utils.sync_global_devices("deeprest_ckpt_shards_written")
     if jax.process_index() == 0:
         manifest = {"format": _FORMAT, "step": int(step), "leaves": leaves}
-        with open(os.path.join(tmp, _MANIFEST), "w", encoding="utf-8") as f:
-            json.dump(manifest, f, indent=1, sort_keys=True)
+        # Manifest + sidecar are fsynced, then the tmp DIRECTORY (its
+        # entries — the shard files synced above as they were written),
+        # and only then the atomic rename + parent-dir sync publish the
+        # step: a host crash at any instant leaves either no step_N dir
+        # or a complete one, never a manifest naming missing shards.
+        _write_json_durable(os.path.join(tmp, _MANIFEST), manifest,
+                            indent=1, sort_keys=True)
         if extra is not None:
             # tmp dir + final rename: a crash mid-write must leave no torn
             # sidecar (a torn one would wedge every consumer that reads it
             # at startup)
-            with open(os.path.join(tmp, _SIDECAR), "w",
-                      encoding="utf-8") as f:
-                json.dump(extra, f, indent=2, sort_keys=True)
+            _write_json_durable(os.path.join(tmp, _SIDECAR), extra,
+                                indent=2, sort_keys=True)
+        _fsync_dir(arrays_dir)
+        _fsync_dir(tmp)
         if os.path.isdir(path):
             import shutil
 
             shutil.rmtree(path)      # force-overwrite an existing step
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path))
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -230,6 +269,24 @@ def load_sidecar(directory: str, step: int | None = None,
         raise
 
 
+def latest_cursor_step(directory: str) -> int | None:
+    """Newest checkpoint step whose sidecar carries a full epoch-plan
+    ``train_cursor`` (written by the trainer's preemption snapshots) —
+    the anchor ``Trainer.resume_training`` restarts from.  Steps without
+    a cursor (plain epoch-cadence checkpoints, streaming refresh
+    checkpoints with the light cursor) are skipped, so a resumable
+    snapshot behind a newer non-resumable save is still found."""
+    for step in reversed(list_steps(directory)):
+        extra = load_sidecar(directory, step, missing_ok=True)
+        if extra is None:
+            continue
+        cur = extra.get("train_cursor")
+        if isinstance(cur, dict) and cur.get("epoch") is not None \
+                and cur.get("rng_state") is not None:
+            return step
+    return None
+
+
 def prune_checkpoints(directory: str, keep: int) -> list[int]:
     """Delete all but the newest ``keep`` checkpoint steps; returns the
     pruned step numbers. A forever-process (streaming retrain) would
@@ -272,9 +329,30 @@ def _assemble(arrays_dir: str, entry: dict, idx, shape) -> np.ndarray:
         if hit is None:
             continue
         dst, src = hit
-        data = np.load(os.path.join(arrays_dir, shard["file"]),
-                       mmap_mode="r")
-        out[dst] = _from_storage(np.asarray(data[src]), entry["dtype"])
+        fpath = os.path.join(arrays_dir, shard["file"])
+        # A torn/truncated shard (host crash mid-writeback on a
+        # pre-fsync-era checkpoint, disk corruption, a copy that died)
+        # must raise CLEANLY here, never hand garbage to the trainer:
+        # np.load's failure modes on a short file range from ValueError
+        # to OSError to a successful mmap whose data region is short —
+        # normalize them all into one diagnosable error.
+        try:
+            data = np.load(fpath, mmap_mode="r")
+            chunk = np.asarray(data[src])
+        except (ValueError, OSError, EOFError, IndexError) as exc:
+            raise ValueError(
+                f"checkpoint shard {shard['file']!r} of leaf "
+                f"{entry['name']!r} is truncated or corrupt ({exc}); "
+                "the checkpoint step is unusable — restore an earlier "
+                "step") from exc
+        expect = tuple(s.stop - s.start for s in src)
+        if chunk.shape != expect:
+            raise ValueError(
+                f"checkpoint shard {shard['file']!r} of leaf "
+                f"{entry['name']!r} is truncated: stored shape "
+                f"{chunk.shape} cannot satisfy the manifest's "
+                f"{expect} slice; restore an earlier step")
+        out[dst] = _from_storage(chunk, entry["dtype"])
         filled += int(np.prod([s.stop - s.start for s in dst], dtype=np.int64))
     if filled != int(np.prod(out_shape, dtype=np.int64)):
         raise ValueError(

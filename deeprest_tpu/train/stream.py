@@ -403,6 +403,11 @@ class StreamingTrainer:
         self._warned_new_metrics: set[str] = set()
         self._pending = 0
         self._refresh_count = 0
+        # Monotone ingest watermark (buckets ever committed, across
+        # resumes): rides in every checkpoint/snapshot sidecar so a
+        # restarted stream knows how far the corpus had advanced — the
+        # retained-ring half of the preemption cursor (ROADMAP item 7).
+        self._ingested_total = 0
         # Set on resume: the delta mask the restored params were TRAINED
         # with.  refresh() must keep using it — y_stats and params both
         # encode the target space, so silently switching a resumed stream
@@ -448,6 +453,7 @@ class StreamingTrainer:
         if self._target_ring is not None:
             self._append_target_row(row)
         self._pending += 1
+        self._ingested_total += 1
 
     def _append_target_row(self, row: dict[str, float]) -> None:
         slot = self._target_ring.append_slot()
@@ -622,6 +628,7 @@ class StreamingTrainer:
             self.config = dataclasses.replace(self.config, model=model)
             self.trainer = Trainer(self.config, self.space.capacity,
                                    bundle.metric_names)
+            self._wire_snapshots()
         if self.state is None:
             self.state = self.trainer.init_state(
                 self.trainer.sample_input(bundle))
@@ -651,6 +658,7 @@ class StreamingTrainer:
                 extra_host_state={
                     "stream_refresh_count": self._refresh_count,
                     "stream_x_union": self.x_union.to_dict(),
+                    "stream_ring_watermark": self._ring_watermark(),
                 })
             from deeprest_tpu.train.checkpoint import prune_checkpoints
 
@@ -659,6 +667,43 @@ class StreamingTrainer:
             refresh=self._refresh_count, num_buckets=self.num_buckets,
             train_loss=train_loss, eval_loss=float(eval_loss),
             checkpoint_path=path)
+
+    # -- preemption snapshots (ROADMAP item 7, dynamic half) ------------
+
+    def _ring_watermark(self) -> dict:
+        """The retained-ring half of the preemption cursor: how far the
+        corpus had advanced when this checkpoint was cut."""
+        return {
+            "ingested_total": int(self._ingested_total),
+            "retained_buckets": int(self.num_buckets),
+            "pending_buckets": int(self._pending),
+        }
+
+    def _snapshot_extra(self) -> dict:
+        out = {
+            "stream_refresh_count": self._refresh_count,
+            "stream_ring_watermark": self._ring_watermark(),
+        }
+        if self.x_union is not None:
+            out["stream_x_union"] = self.x_union.to_dict()
+        return out
+
+    def _wire_snapshots(self) -> None:
+        """Mid-refresh preemption snapshots (TrainConfig.
+        snapshot_every_steps > 0): every N fine-tune steps the embedded
+        trainer checkpoints atomically WITH the full stream sidecar
+        (frozen metric set, stats, refresh counter, retained-ring
+        watermarks via ``extra_fn``), so a stream killed mid-refresh
+        resumes from params at most N steps stale instead of losing the
+        whole refresh — _maybe_resume adopts a snapshot exactly like a
+        refresh checkpoint.  The stream deliberately does NOT plan-replay
+        the interrupted fine-tune (its refresh loop re-trains over the
+        retained corpus every cycle anyway); the epoch-plan cursor
+        resume is Trainer.resume_training's offline contract."""
+        n = self.config.train.snapshot_every_steps
+        if n and self.ckpt_dir and self.trainer is not None:
+            self.trainer.enable_snapshots(self.ckpt_dir, n,
+                                          extra_fn=self._snapshot_extra)
 
     # -- resume ---------------------------------------------------------
 
@@ -716,6 +761,7 @@ class StreamingTrainer:
             num_metrics=len(self.metric_names))
         self.config = dataclasses.replace(self.config, model=model)
         self.trainer = Trainer(self.config, feature_dim, self.metric_names)
+        self._wire_snapshots()
         target = self.trainer.init_state(np.zeros(  # graftlint: disable=DN001 -- one [1, W, F] init SAMPLE (shape donor for param init), not a corpus-scale materialization
             (1, self.config.train.window_size, feature_dim), np.float32))
         self.state, _ = restore_checkpoint(self.ckpt_dir, target, step=step)
@@ -724,6 +770,13 @@ class StreamingTrainer:
         except (TypeError, ValueError):
             print("stream: checkpoint carries a malformed "
                   "stream_refresh_count; numbering restarts at 0")
+        wm = extra.get("stream_ring_watermark")
+        if isinstance(wm, dict):
+            try:
+                # continue the monotone ingest watermark across restarts
+                self._ingested_total = int(wm.get("ingested_total", 0))
+            except (TypeError, ValueError):
+                pass
         print(f"stream: resumed from {self.ckpt_dir} "
               f"(refresh {self._refresh_count}, "
               f"{len(self.metric_names)} metrics frozen)")

@@ -76,6 +76,20 @@ class Trainer:
         # readback per epoch/superstep) — the superstep-vs-per-step parity
         # tests and callers that want the full curve read this.
         self._last_epoch_losses: np.ndarray | None = None
+        # Preemption-safe snapshot state (enable_snapshots / ROADMAP item
+        # 7 dynamic half).  The epoch-plan cursor lives here between the
+        # fit loop (which pins the epoch index + the shuffle rng's
+        # bit-generator state at epoch START) and the epoch drivers
+        # (which advance the step offset at step/superstep boundaries).
+        self._snapshot_dir: str | None = None
+        self._snapshot_every = 0
+        self._snapshot_extra_fn = None
+        self._steps_since_snapshot = 0
+        self._snapshots_written = 0
+        self._cursor_epoch: int | None = None
+        self._cursor_rng_state: dict | None = None
+        self._epoch_steps_done = 0
+        self._epoch_num_steps = 0
 
         quantiles = self.model_config.quantiles
 
@@ -377,6 +391,9 @@ class Trainer:
             "deeprest_train_jit_executables",
             "compiled executables across the trainer's jitted programs "
             "(compile events = increases)")
+        self._m_snapshots = obs_metrics.REGISTRY.counter(
+            "deeprest_train_snapshots_total",
+            "preemption-safe cursor snapshots written")
 
     def _jit_cache_size(self) -> int | None:
         """Total compiled-executable count across the trainer's jitted
@@ -397,6 +414,80 @@ class Trainer:
         cache = self._jit_cache_size()
         if cache is not None:
             self._m_executables.set(cache)
+
+    # -- preemption-safe snapshots (ROADMAP item 7, dynamic half) ------
+
+    def enable_snapshots(self, directory: str, every_steps: int,
+                         extra_fn=None) -> None:
+        """Periodic preemption-safe snapshots: every ``every_steps`` REAL
+        train steps (the superstep path fires at the first chunk boundary
+        at or past the cadence — its state only exists at boundaries) the
+        full TrainState checkpoints atomically (``deeprest-sharded-v1``,
+        tmp+fsync+rename) together with the epoch-plan cursor: epoch
+        index, steps completed within the epoch, the shuffle rng's
+        bit-generator state at epoch start, and the global step.
+        :meth:`resume_training` restarts from the newest cursor — onto
+        whatever mesh the restarted process has — and is bit-identical
+        to the uninterrupted run at the same step (tests/test_chaos.py).
+
+        ``extra_fn`` (optional) supplies extra sidecar keys per snapshot
+        (the streaming trainer rides its refresh counter, stats union,
+        and retained-ring watermarks here, so a mid-refresh snapshot is
+        a complete stream-resume point too).
+        """
+        if every_steps < 1:
+            raise ValueError(
+                f"enable_snapshots(every_steps={every_steps}): must be "
+                ">= 1 (leave snapshots unconfigured to disable)")
+        self._snapshot_dir = directory
+        self._snapshot_every = int(every_steps)
+        self._snapshot_extra_fn = extra_fn
+        self._steps_since_snapshot = 0
+
+    def _begin_epoch_cursor(self, epoch: int,
+                            data_rng: np.random.Generator) -> None:
+        """Pin the cursor base for one epoch: the epoch index and the rng
+        state BEFORE the epoch plan consumes its permutation, so a resume
+        regenerates the identical shuffle and skips into it."""
+        import copy
+
+        self._cursor_epoch = epoch
+        self._cursor_rng_state = copy.deepcopy(data_rng.bit_generator.state)
+        self._epoch_steps_done = 0
+
+    def _note_steps(self, state: TrainState, bundle: DatasetBundle,
+                    n: int, on_step=None) -> None:
+        """Advance the epoch cursor by ``n`` real steps; write a snapshot
+        when the cadence is due (never at the epoch's final step — the
+        epoch-end snapshot, whose cursor already points at the next
+        epoch, covers that boundary without a redundant save)."""
+        self._epoch_steps_done += n
+        if self._snapshot_every:
+            self._steps_since_snapshot += n
+            if (self._steps_since_snapshot >= self._snapshot_every
+                    and self._epoch_steps_done < self._epoch_num_steps):
+                self.snapshot(state, bundle)
+        if on_step is not None:
+            on_step(self._global_step)
+
+    def snapshot(self, state: TrainState, bundle: DatasetBundle) -> str:
+        """One atomic cursor snapshot (see :meth:`enable_snapshots`)."""
+        if self._snapshot_dir is None:
+            raise RuntimeError("snapshots not enabled (enable_snapshots)")
+        extra = dict(self._snapshot_extra_fn()) \
+            if self._snapshot_extra_fn is not None else {}
+        extra["train_cursor"] = {
+            "epoch": self._cursor_epoch,
+            "steps_done": int(self._epoch_steps_done),
+            "rng_state": self._cursor_rng_state,
+            "global_step": int(self._global_step),
+        }
+        self._steps_since_snapshot = 0
+        path = self.save(self._snapshot_dir, state, bundle,
+                         extra_host_state=extra)
+        self._snapshots_written += 1
+        self._m_snapshots.inc()
+        return path
 
     # ------------------------------------------------------------------
 
@@ -590,7 +681,17 @@ class Trainer:
 
     def train_epoch(self, state: TrainState, bundle: DatasetBundle,
                     epoch_rng: np.random.Generator,
-                    staged=None) -> tuple[TrainState, float]:
+                    staged=None, skip_steps: int = 0,
+                    on_step=None) -> tuple[TrainState, float]:
+        """One epoch.  ``skip_steps`` (resume) fast-forwards past the
+        first N REAL steps of the epoch's plan WITHOUT running them — the
+        plan rng is still consumed identically, so the remaining steps
+        see exactly the batches an uninterrupted run would have; the
+        returned epoch-mean loss then covers only the executed remainder
+        (the resumed epoch's mean is not comparable to the uninterrupted
+        one — state parity is, and is what tests/test_chaos.py pins).
+        ``on_step(global_step)`` fires at every real-step (superstep:
+        chunk) boundary — the chaos tests' preemption injection point."""
         accum = self.config.train.grad_accum_windows
         if staged is None and bundle.is_sparse:
             raise ValueError(
@@ -611,7 +712,17 @@ class Trainer:
             s = self._superstep_len(num_steps)
             if s > 1:
                 return self._train_epoch_superstep(state, bundle, epoch_rng,
-                                                   staged, s)
+                                                   staged, s,
+                                                   skip_steps=skip_steps,
+                                                   on_step=on_step)
+        self._epoch_num_steps = -(-bundle.num_train_windows
+                                  // self.config.train.batch_size)
+        self._epoch_steps_done = skip_steps
+        if skip_steps >= self._epoch_num_steps:
+            raise ValueError(
+                f"skip_steps={skip_steps} >= epoch length "
+                f"{self._epoch_num_steps}: a finished epoch resumes at "
+                "the NEXT epoch's cursor, never by skipping a whole plan")
         log_every = self.config.train.log_every_steps
         losses = []
         steps = 0
@@ -623,9 +734,13 @@ class Trainer:
                 # feed_global_batch (inside prefetch): sharded device_put on
                 # one host; on a pod, each process ships only its
                 # process_batch_slice of the (identical, rng-deterministic)
-                # global selection.
-                for sel, weight in self._batches(bundle.num_train_windows,
-                                                 epoch_rng):
+                # global selection.  Resume: the first skip_steps batches
+                # of the (identical) shuffle are discarded host-side —
+                # never staged, never run.
+                for i, (sel, weight) in enumerate(self._batches(
+                        bundle.num_train_windows, epoch_rng)):
+                    if i < skip_steps:
+                        continue
                     yield bundle.x_train[sel], bundle.y_train[sel], weight
 
             batches = prefetch_to_device(self.mesh, host_batches(),
@@ -642,8 +757,10 @@ class Trainer:
                 # keeps the [B] start/weight copies of step t+1 in flight
                 # behind the step on batch t — the superstep-disabled
                 # fallback overlaps transfer with compute too.
-                for sel, weight in self._batches(bundle.num_train_windows,
-                                                 epoch_rng):
+                for i, (sel, weight) in enumerate(self._batches(
+                        bundle.num_train_windows, epoch_rng)):
+                    if i < skip_steps:
+                        continue
                     yield sel.astype(np.int32), weight
 
             batches = prefetch_to_device(self.mesh, index_batches(),
@@ -668,6 +785,7 @@ class Trainer:
                 self._m_readbacks.inc(sink="log_boundary")
                 # graftlint: disable=JX003 -- designed sink: one scalar readback per log_every steps, the logging contract
                 print(f"step {self._global_step}: loss {float(loss):.6f}")
+            self._note_steps(state, bundle, 1, on_step)
         jax.block_until_ready(state.params)
         if measuring:
             self.throughput.stop(steps)
@@ -683,7 +801,8 @@ class Trainer:
 
     def _train_epoch_superstep(self, state: TrainState, bundle: DatasetBundle,
                                epoch_rng: np.random.Generator, staged,
-                               s: int) -> tuple[TrainState, float]:
+                               s: int, skip_steps: int = 0,
+                               on_step=None) -> tuple[TrainState, float]:
         """Fused epoch driver: ceil(K/S) donated dispatches instead of K.
 
         The epoch's whole shuffled plan ships to HBM once (stage_plan);
@@ -692,12 +811,32 @@ class Trainer:
         mean / a log boundary needs values).  Numerics are bit-identical
         to the per-step indexed loop: same plan rng, same fold_in(rng,
         step) stream, padded steps select the prior state.
+
+        ``skip_steps`` (resume) must land on a superstep boundary — the
+        snapshot cadence only ever fires there, so a cursor that does not
+        divide is a corrupted sidecar, not a rounding case.  The whole
+        plan is still built (one permutation off ``epoch_rng``, identical
+        to the uninterrupted epoch) and the first ``skip_steps/s`` chunks
+        are never dispatched.
         """
         cfg = self.config.train
         log_every = cfg.log_every_steps
         x_base, y_base = staged
         starts, weights, num_steps = self._epoch_plan(
             bundle.num_train_windows, epoch_rng, s)
+        self._epoch_num_steps = num_steps
+        self._epoch_steps_done = skip_steps
+        if skip_steps >= num_steps:
+            raise ValueError(
+                f"skip_steps={skip_steps} >= epoch length {num_steps}: a "
+                "finished epoch resumes at the NEXT epoch's cursor")
+        if skip_steps % s:
+            raise ValueError(
+                f"resume cursor steps_done={skip_steps} is not a "
+                f"superstep boundary (S={s}): snapshots only fire at "
+                "chunk boundaries — the sidecar is inconsistent with "
+                "this config's steps_per_superstep/grad_accum_windows")
+        skip_chunks = skip_steps // s
         starts_d, weights_d = stage_plan(self.mesh, starts, weights)
         # The coalesced (grad-accum) superstep and the per-step superstep
         # share the whole driver: only the compiled scan differs.
@@ -708,7 +847,7 @@ class Trainer:
             self.throughput.start()
         chunk_losses = []
         steps = 0
-        for c in range(starts.shape[0]):
+        for c in range(skip_chunks, starts.shape[0]):
             real = min(s, num_steps - c * s)
             state, losses_c = superstep(state, x_base, y_base,
                                         starts_d, weights_d, c)
@@ -730,15 +869,18 @@ class Trainer:
                 for gs in range(prev + 1, self._global_step + 1):
                     if gs % log_every == 0:
                         print(f"step {gs}: loss {vals[gs - prev - 1]:.6f}")
-        self._m_dispatches.inc(starts.shape[0])
+            self._note_steps(state, bundle, real, on_step)
+        self._m_dispatches.inc(starts.shape[0] - skip_chunks)
         jax.block_until_ready(state.params)
         if measuring:
             self.throughput.stop(steps)
         self._publish_epoch_metrics()
-        # Padding only ever trails the real steps, so [:num_steps] of the
-        # concatenated chunks is exactly the epoch's per-step loss curve.
+        # Padding only ever trails the real steps, so clipping the
+        # concatenated chunks to the executed real-step count recovers
+        # exactly the (remaining) per-step loss curve.
         self._m_readbacks.inc(sink="epoch_losses")
-        epoch_losses = np.asarray(jnp.concatenate(chunk_losses))[:num_steps]
+        epoch_losses = np.asarray(
+            jnp.concatenate(chunk_losses))[:num_steps - skip_steps]
         self._last_epoch_losses = epoch_losses
         return state, float(np.mean(epoch_losses, dtype=np.float64))
 
@@ -837,17 +979,93 @@ class Trainer:
         baseline_preds: Mapping[str, np.ndarray] | None = None,
         on_epoch: Callable[[EpochResult, TrainState], None] | None = None,
         num_epochs: int | None = None,
+        on_step=None,
     ) -> tuple[TrainState, list[EpochResult]]:
-        cfg = self.config.train
         if state is None:
             state = self.init_state(self.sample_input(bundle))
+        data_rng = np.random.default_rng(self.config.train.seed)
+        return self._run_epochs(bundle, state, data_rng, 0, 0,
+                                baseline_preds, on_epoch, num_epochs,
+                                on_step)
+
+    def resume_training(
+        self,
+        bundle: DatasetBundle,
+        directory: str | None = None,
+        baseline_preds: Mapping[str, np.ndarray] | None = None,
+        on_epoch: Callable[[EpochResult, TrainState], None] | None = None,
+        num_epochs: int | None = None,
+        on_step=None,
+    ) -> tuple[TrainState, list[EpochResult]]:
+        """Restart a preempted :meth:`fit` from its newest cursor
+        snapshot and run to completion, bit-identical to the
+        uninterrupted run at every later step.
+
+        The restore lands on WHATEVER MESH this trainer was built with —
+        the cross-mesh sharded restore (round 12) assembles by global
+        index, so a run preempted on a 2×2×2 slice resumes on the 1×1×1
+        that survived.  The epoch plan replays from the cursor: the
+        shuffle rng's bit-generator state is restored to the interrupted
+        epoch's start, the plan regenerates identically, and the first
+        ``steps_done`` steps are skipped without running (subsequent
+        steps therefore see exactly the batches, dropout streams, and
+        step counters of the uninterrupted run — the kill-at-step-K
+        parity contract tests/test_chaos.py pins).
+        """
+        from deeprest_tpu.train.checkpoint import (
+            latest_cursor_step, restore_checkpoint,
+        )
+
+        cfg = self.config.train
+        directory = directory or self._snapshot_dir or cfg.checkpoint_dir
+        if not directory:
+            raise ValueError("resume_training needs a snapshot directory "
+                             "(TrainConfig.checkpoint_dir or the "
+                             "directory argument)")
+        step = latest_cursor_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no cursor-bearing snapshot under {directory!r} — "
+                "nothing to resume (run fit with "
+                "TrainConfig.snapshot_every_steps > 0 first)")
+        template = self.init_state(self.sample_input(bundle))
+        state, extra = restore_checkpoint(directory, template, step=step)
+        cursor = extra["train_cursor"]
+        self._global_step = int(cursor["global_step"])
         data_rng = np.random.default_rng(cfg.seed)
+        data_rng.bit_generator.state = cursor["rng_state"]
+        return self._run_epochs(bundle, state, data_rng,
+                                int(cursor["epoch"]),
+                                int(cursor["steps_done"]),
+                                baseline_preds, on_epoch, num_epochs,
+                                on_step)
+
+    def _run_epochs(
+        self,
+        bundle: DatasetBundle,
+        state: TrainState,
+        data_rng: np.random.Generator,
+        start_epoch: int,
+        skip_steps: int,
+        baseline_preds: Mapping[str, np.ndarray] | None,
+        on_epoch: Callable[[EpochResult, TrainState], None] | None,
+        num_epochs: int | None,
+        on_step=None,
+    ) -> tuple[TrainState, list[EpochResult]]:
+        cfg = self.config.train
+        if cfg.snapshot_every_steps and cfg.checkpoint_dir \
+                and self._snapshot_dir is None:
+            self.enable_snapshots(cfg.checkpoint_dir,
+                                  cfg.snapshot_every_steps)
         history: list[EpochResult] = []
         total = num_epochs if num_epochs is not None else cfg.num_epochs
-        staged = self.stage_dataset(bundle) if total else None
-        for epoch in range(total):
-            state, train_loss = self.train_epoch(state, bundle, data_rng,
-                                                 staged=staged)
+        staged = self.stage_dataset(bundle) if total > start_epoch else None
+        for epoch in range(start_epoch, total):
+            self._begin_epoch_cursor(epoch, data_rng)
+            state, train_loss = self.train_epoch(
+                state, bundle, data_rng, staged=staged,
+                skip_steps=(skip_steps if epoch == start_epoch else 0),
+                on_step=on_step)
             test_loss, report = self.evaluate(state, bundle, baseline_preds,
                                               staged=staged)
             result = EpochResult(epoch=epoch, train_loss=train_loss,
@@ -855,10 +1073,19 @@ class Trainer:
             history.append(result)
             if on_epoch is not None:
                 on_epoch(result, state)
-            if cfg.checkpoint_dir and (
+            # Epoch-boundary cursor: the NEXT epoch at step 0, with the
+            # rng state the plan draw left behind — a kill between epochs
+            # resumes exactly at the boundary.  The epoch-end snapshot
+            # subsumes the plain epoch-cadence save (same full sidecar,
+            # plus the cursor); writing the cursorless save AFTER it
+            # would overwrite the cursor at the same step directory.
+            self._begin_epoch_cursor(epoch + 1, data_rng)
+            cadence_due = cfg.checkpoint_dir and (
                 (epoch + 1) % cfg.checkpoint_every_epochs == 0
-                or epoch + 1 == total
-            ):
+                or epoch + 1 == total)
+            if self._snapshot_dir is not None:
+                self.snapshot(state, bundle)
+            elif cadence_due:
                 self.save(cfg.checkpoint_dir, state, bundle)
         return state, history
 

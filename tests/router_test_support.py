@@ -7,6 +7,38 @@ import numpy as np
 F, E, H, W = 6, 3, 8, 8
 
 
+class SlowBackend:
+    """build_tiny wrapped with a fixed per-call delay — gives the chaos
+    tests a window to SIGKILL a worker MID-request (and the deadline
+    tests a predict that reliably outlives a short timeout).  Metadata
+    and batcher attachment delegate to the inner stack."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self.delay_s = float(delay_s)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def predict_series(self, traffic, integrate=True):
+        import time
+
+        time.sleep(self.delay_s)
+        return self._inner.predict_series(traffic, integrate=integrate)
+
+    def predict_series_many(self, series_list, integrate=True):
+        import time
+
+        time.sleep(self.delay_s)
+        return self._inner.predict_series_many(series_list,
+                                               integrate=integrate)
+
+
+def build_slow(delay_s: float = 1.0, scale: float = 1.0, ladder=(8,)):
+    return SlowBackend(build_tiny(scale=scale, ladder=tuple(ladder)),
+                       delay_s)
+
+
 def build_tiny(scale: float = 1.0, ladder=(8,)):
     import jax
 

@@ -1017,7 +1017,7 @@ def test_rule_registry_complete():
     assert {"JX001", "JX002", "JX003", "JX004",
             "TH001", "TH002", "TH003", "TH004",
             "HY001", "HY002", "OB001", "DN001",
-            "RS001", "RS002", "RS003",
+            "RS001", "RS002", "RS003", "RS004",
             "EX001", "EX002", "EX003"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards
@@ -1410,6 +1410,100 @@ class Counted:
 """
     assert not findings_for("RS003", trivial, rel="serve/replica.py")
     assert not findings_for("RS003", RS003_BAD, rel="data/ingest.py")
+
+
+# ---------------------------------------------------------------------------
+# RS004: unbounded retry loops in the serving plane
+
+
+RS004_BAD = """
+class Router:
+    def dispatch(self, replica, x):
+        while True:
+            try:
+                return replica.predict(x)
+            except ReplicaDeadError:
+                pass
+"""
+
+RS004_GOOD = """
+class Router:
+    def dispatch(self, replica, x, budget=2):
+        attempt = 0
+        while True:
+            try:
+                return replica.predict(x)
+            except ReplicaDeadError:
+                attempt += 1
+                if attempt > budget:
+                    raise
+"""
+
+
+def test_rs004_pair():
+    assert_pair("RS004", RS004_BAD, RS004_GOOD, rel="serve/router.py")
+
+
+def test_rs004_backoff_discharges():
+    # a paced retry (sleep/Event.wait) is bounded-RATE even when
+    # unbounded in count — the probe-loop shape, silent by design
+    src = """
+import time
+
+class Prober:
+    def watch(self, replica):
+        while True:
+            try:
+                replica.probe()
+            except ReplicaDeadError:
+                time.sleep(0.5)
+"""
+    assert not findings_for("RS004", src, rel="serve/router.py")
+
+
+def test_rs004_loop_with_break_in_handler_is_silent():
+    src = """
+class Reader:
+    def loop(self, conn):
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+"""
+    assert not findings_for("RS004", src, rel="serve/replica.py")
+
+
+RS004_RECURSIVE_BAD = """
+class Client:
+    def fetch(self, x):
+        try:
+            return self._do(x)
+        except OSError:
+            return self.fetch(x)
+"""
+
+RS004_RECURSIVE_GOOD = """
+class Client:
+    def fetch(self, x, attempt=0):
+        if attempt >= 3:
+            raise RuntimeError("gave up")
+        try:
+            return self._do(x)
+        except OSError:
+            return self.fetch(x, attempt + 1)
+"""
+
+
+def test_rs004_recursive_pair():
+    assert_pair("RS004", RS004_RECURSIVE_BAD, RS004_RECURSIVE_GOOD,
+                rel="serve/predictor.py")
+
+
+def test_rs004_outside_serve_watchlist_is_silent():
+    assert not findings_for("RS004", RS004_BAD, rel="train/stream.py")
+    assert not findings_for("RS004", RS004_RECURSIVE_BAD,
+                            rel="data/ingest.py")
 
 
 # ---------------------------------------------------------------------------

@@ -553,7 +553,8 @@ def test_serve_help_covers_replica_flags(capsys):
     out = capsys.readouterr().out
     for flag in ("--replicas", "--replica-mode", "--admission-depth",
                  "--tenant-weights", "--autoscale", "--autoscale-manifest",
-                 "--admission-wait-ms"):
+                 "--admission-wait-ms", "--replica-timeout-ms",
+                 "--eject-after-failures", "--retry-budget"):
         assert flag in out, f"serve --help missing {flag}"
 
 
@@ -586,4 +587,6 @@ def test_process_replica_same_interface_and_results(traffic):
         assert np.array_equal(router.predict_series(traffic), reference)
     finally:
         rep.close()
-    assert not rep._proc.is_alive()
+    # public liveness probe: the worker is reaped AND its parent-side
+    # resources (Popen sentinel fd) released
+    assert not rep.alive()
