@@ -35,6 +35,12 @@
 #                         leaked threads/processes/fds) — refreshes
 #                         benchmarks/chaos_bench.json; the on-chip storm
 #                         rides benchmarks/tpu_queue.sh chaos_storm tenk_vertical
+#   make drift-bench      the model-quality observability gate (topology
+#                         shift detection latency, ransomware-mid-drift,
+#                         clean-corpus zero verdicts, <=3% monitor
+#                         overhead) — refreshes benchmarks/
+#                         drift_bench.json; the on-chip overhead number
+#                         rides benchmarks/tpu_queue.sh drift_overhead
 
 PYTHON ?= python
 
@@ -65,5 +71,8 @@ tenk-bench:
 chaos-bench:
 	$(PYTHON) benchmarks/chaos_bench.py --out benchmarks/chaos_bench.json
 
+drift-bench:
+	$(PYTHON) benchmarks/drift_bench.py --out benchmarks/drift_bench.json
+
 .PHONY: lint lint-changed native tsan bench-multichip \
-	serve-bench-replicas obs-bench tenk-bench chaos-bench
+	serve-bench-replicas obs-bench tenk-bench chaos-bench drift-bench

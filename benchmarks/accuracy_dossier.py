@@ -430,6 +430,20 @@ def main():
                     help="comma-separated resources trained as per-bucket "
                          "increments (default: TrainConfig default; 'none' "
                          "disables — the A/B lever for the delta head)")
+    ap.add_argument("--sparse-feed", action="store_true",
+                    help="train the month-scale F=10240 corpus through "
+                         "the round-15 sparse-first feed (padded-COO "
+                         "rows, one on-device densify inside the train/"
+                         "eval executables): ~80x fewer staged feed "
+                         "bytes at 10k width, losses bit-identical to "
+                         "the dense reference (tests/test_sparse.py) — "
+                         "the feed the on-chip dossier run should use "
+                         "(ROADMAP item 6 names this arm as owed)")
+    ap.add_argument("--sparse-nnz-cap", type=int, default=128,
+                    help="padded-COO row width under --sparse-feed (a "
+                         "month-10k bucket averages ~53 nonzero call-"
+                         "path columns; a fatter row raises rather than "
+                         "dropping traffic)")
     args = ap.parse_args()
     if args.delta_resources is not None:
         requested = {r for r in args.delta_resources.split(",")
@@ -536,6 +550,19 @@ def main():
     data.metric_names = metric_names
     data.space = space
 
+    nnz_cap = args.sparse_nnz_cap
+    if args.sparse_feed:
+        # Size the K cap to the corpus (the documented policy: overflow
+        # RAISES rather than dropping call paths) — the dossier holds the
+        # whole traffic tensor here, so measure instead of guessing.
+        # Smoke/reduced topologies are much denser than the 10k corpus
+        # (~85% occupancy at F=256 vs ~0.5% at F=10240).
+        observed_max = int(np.max(np.count_nonzero(traffic, axis=-1)))
+        if observed_max > nnz_cap:
+            print(f"sparse-feed: corpus max nnz {observed_max} exceeds "
+                  f"--sparse-nnz-cap {nnz_cap}; sizing the cap to the "
+                  "corpus", flush=True)
+            nnz_cap = observed_max
     cfg = Config(
         model=ModelConfig(feature_dim=feat_dim, num_metrics=len(metric_names),
                           hidden_size=128,
@@ -545,6 +572,8 @@ def main():
         train=TrainConfig(batch_size=32, window_size=window,
                           num_epochs=epochs, log_every_steps=0, seed=0,
                           eval_stride=window,
+                          sparse_feed=args.sparse_feed,
+                          sparse_nnz_cap=nnz_cap,
                           **({} if args.delta_resources is None else {
                               "delta_resources": tuple(
                                   r for r in args.delta_resources.split(",")
@@ -632,6 +661,8 @@ def main():
         "feature_dim": feat_dim,
         "num_metrics": len(metric_names),
         "window": window,
+        "sparse_feed": bool(args.sparse_feed),
+        "sparse_nnz_cap": nnz_cap if args.sparse_feed else None,
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     with open(args.out_json, "w", encoding="utf-8") as f:

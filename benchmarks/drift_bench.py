@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""drift_bench: the model-quality observability gate (ROADMAP item 6).
+
+Four arms over the obs/quality.py + train/stream.DriftController loop:
+
+- **detection** — a synthetic topology-shift corpus (services added/
+  removed mid-corpus via the ``--shift-at`` generator,
+  workload/simulator.simulate_drift_corpus_iter): the drift verdict must
+  stay SILENT through the pre-shift regime (scenario mixes churn every
+  cycle by design — that is seen-scale variation, not drift), flag
+  within the budgeted window count after the shift, auto-trigger a
+  retrain on the retained rings, EXIT once the retrained reference
+  covers the new regime, and recover band coverage.
+- **ransomware-mid-drift** — the same shift plus a traffic-decoupled IO
+  consumer injected after it (workload/telemetry.Anomaly): the loop must
+  flag the drift, retrain through it, and the excess that SURVIVES the
+  fresh model must surface as an ANOMALY verdict on the attacked store's
+  metrics (the temporal-disambiguation rule: drift masks anomaly while
+  the band is untrustworthy; what outlives the retrain is real).
+- **clean** — the same generator without shift or anomaly: a mature
+  plane must produce ZERO drift/anomaly verdicts and zero auto-retrains.
+- **overhead** — the monitors on the serve + train hot paths, A/B, must
+  stay inside the round-14 ≤3% obs budget (quick mode relaxes to 15% —
+  CPU timing noise at tiny trial counts must not flake tier-1; the
+  committed full run asserts the real budget).
+
+Run ``python benchmarks/drift_bench.py --out benchmarks/drift_bench.json``
+(the committed artifact; ``make drift-bench``).  ``--quick`` is the
+tier-1 smoke (tests/test_drift_bench.py); ``--headline`` prints one JSON
+line with ``drift_detection_sweeps`` + ``drift_overhead_pct`` for
+bench.py (schema v10).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BUDGET_PCT = 3.0
+QUICK_BUDGET_PCT = 15.0
+
+# Corpus shape: small enough to run on CPU in minutes, structured enough
+# to exercise the real pipeline (synthetic layered DAG, per-cycle
+# Dirichlet mixes, stateful telemetry).
+SERVICES_BEFORE, SERVICES_AFTER, ENDPOINTS = 8, 14, 4
+CAPACITY, WINDOW = 128, 8
+
+
+def _scenario(cycle_len: int, seed: int = 0):
+    from deeprest_tpu.workload.scenarios import normal_scenario
+
+    sc = normal_scenario(seed=seed)
+    sc.calls_per_user = 0.5
+    sc.base_users = 40.0
+    sc.peak_range = (56.0, 80.0)
+    sc.cycle_len = cycle_len
+    return sc
+
+
+def _corpus(num_buckets: int, shift_at: int | None, cycle_len: int,
+            anomalies=None, seed: int = 0):
+    """(buckets, after_app) — shift_at=None generates a clean corpus
+    from the BEFORE topology only."""
+    from deeprest_tpu.workload.simulator import (
+        build_shifted_app, simulate_drift_corpus_iter,
+    )
+
+    sc = _scenario(cycle_len, seed)
+    before, after, endpoints = build_shifted_app(
+        sc, SERVICES_BEFORE, SERVICES_AFTER, ENDPOINTS, seed=seed)
+    if shift_at is None:
+        shift_at = num_buckets + 1      # the after app is never reached
+        it = simulate_drift_corpus_iter(sc, num_buckets, num_buckets,
+                                        before, after, endpoints,
+                                        anomalies=anomalies)
+    else:
+        it = simulate_drift_corpus_iter(sc, num_buckets, shift_at,
+                                        before, after, endpoints,
+                                        anomalies=anomalies)
+    return list(it), after
+
+
+def _quality_config(cycle_len: int):
+    from deeprest_tpu.config import QualityConfig
+
+    # Windows span whole traffic cycles: the generator re-draws API
+    # mixes per cycle, so sub-cycle windows read phase as drift.  The
+    # enter threshold sits above the measured natural mix churn (fresh
+    # per-cycle Dirichlet compositions over few endpoints peak at
+    # weighted PSI ~0.85 with 2-cycle live windows) and below the
+    # topology-shift signal (1.7–3.5): seen-scale variation stays
+    # silent, structural change flags.
+    return QualityConfig(
+        enabled=True, sweep_every_buckets=cycle_len // 2,
+        live_window=2 * cycle_len, reference_window=4 * cycle_len,
+        min_sweep_buckets=WINDOW, sustain_enter=2, sustain_exit=2,
+        drift_enter=1.0, drift_exit=0.5,
+        calibration_enter=0.5, calibration_exit=0.25,
+        retrain_cooldown_buckets=3 * cycle_len,
+        model_warmup_refreshes=4)
+
+
+def _run_stream(buckets, qc, finetune_epochs: int = 2):
+    """Drive the full loop over an in-memory corpus; returns the record
+    drift_bench's gates read (events in STREAM bucket space)."""
+    from deeprest_tpu.config import (
+        Config, FeaturizeConfig, ModelConfig, TrainConfig,
+    )
+    from deeprest_tpu.train.stream import (
+        DriftController, StreamConfig, StreamingTrainer,
+    )
+
+    cfg = Config(
+        model=ModelConfig(feature_dim=CAPACITY, hidden_size=8),
+        train=TrainConfig(batch_size=8, window_size=WINDOW, seed=0,
+                          eval_stride=1, eval_max_cycles=2,
+                          log_every_steps=0))
+    st = StreamingTrainer(
+        cfg,
+        StreamConfig(refresh_buckets=40, finetune_epochs=finetune_epochs,
+                     history_max=360, eval_holdout=2),
+        ckpt_dir=None,
+        feature_config=FeaturizeConfig(hash_features=True,
+                                       capacity=CAPACITY))
+    controller = DriftController(st, qc)
+    events = []                  # (stream_bucket, stream, state)
+    refreshes = []
+    seen_events = 0
+    t0 = time.perf_counter()
+    for i, b in enumerate(buckets):
+        st.ingest(b)
+        if st.ready():
+            refreshes.append((i, st.refresh().trigger))
+        if controller.monitor is not None:
+            fresh = controller.monitor.events[seen_events:]
+            seen_events += len(fresh)
+            events.extend((i, s, state) for _, s, state in fresh)
+    return {
+        "events": events,
+        "refreshes": refreshes,
+        "stats": controller.stats,
+        "monitor": controller.monitor,
+        "wall_s": time.perf_counter() - t0,
+        "sweep_every": qc.sweep_every_buckets,
+    }
+
+
+def _first(events, stream, state):
+    return next((b for b, s, st in events
+                 if s == stream and st == state), None)
+
+
+def measure_detection(quick: bool) -> dict:
+    cycle = 30 if quick else 60
+    shift = 8 * cycle
+    total = shift + 6 * cycle
+    qc = _quality_config(cycle)
+    buckets, _ = _corpus(total, shift, cycle)
+    run = _run_stream(buckets, qc)
+    ev = run["events"]
+    enter = _first(ev, "feature_drift", "drift")
+    exited = next((b for b, s, st in ev if s == "feature_drift"
+                   and st == "ok" and enter is not None and b > enter),
+                  None)
+    drift_refreshes = [i for i, t in run["refreshes"] if t == "drift"]
+    cov = run["monitor"].calibration.coverage()
+    out = {
+        "cycle_len": cycle,
+        "shift_at": shift,
+        "buckets": total,
+        "flagged_at": enter,
+        "false_flags_before_shift": sum(
+            1 for b, s, st in ev if s == "feature_drift"
+            and st == "drift" and b < shift),
+        # windows-to-flag: the headline detection latency, in sweeps
+        "detection_buckets": (enter - shift if enter is not None
+                              else None),
+        "detection_sweeps": (round((enter - shift) / qc.sweep_every_buckets,
+                                   2) if enter is not None else None),
+        # the live window must refill with post-shift data before the
+        # verdict CAN flip; budget = fill + sustain + slack
+        "budget_sweeps": round(
+            (qc.live_window + qc.sweep_every_buckets
+             * (qc.sustain_enter + 2)) / qc.sweep_every_buckets, 2),
+        "retrains_triggered": run["stats"]["retrains_triggered"],
+        "first_drift_retrain_at": (drift_refreshes[0]
+                                   if drift_refreshes else None),
+        "drift_exited_at": exited,
+        "coverage_end_median": (round(float(np.median(cov)), 3)
+                                if cov is not None else None),
+        "wall_s": round(run["wall_s"], 2),
+    }
+    out["ok"] = (out["false_flags_before_shift"] == 0
+                 and out["detection_sweeps"] is not None
+                 and out["detection_sweeps"] <= out["budget_sweeps"]
+                 and out["retrains_triggered"] >= 1
+                 and out["drift_exited_at"] is not None)
+    return out
+
+
+def measure_ransomware_mid_drift(quick: bool) -> dict:
+    from deeprest_tpu.workload.telemetry import Anomaly
+
+    cycle = 30 if quick else 60
+    shift = 8 * cycle
+    total = shift + 10 * cycle
+    qc = _quality_config(cycle)
+    # pick the attacked store from the AFTER topology (it must exist in
+    # the drifted regime the ransomware rides on)
+    _, after = _corpus(1, None, cycle)
+    store = next(c for c in after.components
+                 if c.endswith(("-mongodb", "-redis")))
+    # the consumer starts after the loop has had time to retrain through
+    # the drift — the excess that survives the fresh model is the signal
+    anomaly_start = shift + 5 * cycle
+    buckets, _ = _corpus(
+        total, shift, cycle,
+        anomalies=[Anomaly(kind="ransomware", component=store,
+                           start=anomaly_start, end=total,
+                           magnitude=8.0)])
+    run = _run_stream(buckets, qc)
+    ev = run["events"]
+    drift_at = _first(ev, "feature_drift", "drift")
+    anomaly_events = [(b, s) for b, s, st in ev
+                      if st == "anomaly" and s.startswith(store)]
+    out = {
+        "cycle_len": cycle,
+        "shift_at": shift,
+        "anomaly_start": anomaly_start,
+        "store": store,
+        "buckets": total,
+        "drift_flagged_at": drift_at,
+        "retrains_triggered": run["stats"]["retrains_triggered"],
+        "anomaly_flagged_at": (anomaly_events[0][0]
+                               if anomaly_events else None),
+        "anomaly_metrics": sorted({s for _, s in anomaly_events}),
+        "wall_s": round(run["wall_s"], 2),
+    }
+    out["ok"] = (drift_at is not None and drift_at >= shift
+                 and out["anomaly_flagged_at"] is not None
+                 and out["anomaly_flagged_at"] >= anomaly_start)
+    return out
+
+
+def measure_clean(quick: bool) -> dict:
+    cycle = 30 if quick else 60
+    total = 14 * cycle
+    qc = _quality_config(cycle)
+    buckets, _ = _corpus(total, None, cycle)
+    run = _run_stream(buckets, qc, finetune_epochs=3)
+    bad = [(b, s, st) for b, s, st in run["events"] if st != "ok"]
+    out = {
+        "cycle_len": cycle,
+        "buckets": total,
+        "verdict_events": bad,
+        "retrains_triggered": run["stats"]["retrains_triggered"],
+        "sweeps": run["stats"]["sweeps"],
+        "wall_s": round(run["wall_s"], 2),
+    }
+    out["ok"] = (not bad and out["retrains_triggered"] == 0
+                 and out["sweeps"] >= 3)
+    return out
+
+
+# -- overhead ---------------------------------------------------------------
+
+
+def _build_predictor():
+    import jax
+
+    from deeprest_tpu.config import ModelConfig
+    from deeprest_tpu.data.windows import MinMaxStats
+    from deeprest_tpu.models.qrnn import QuantileGRU
+    from deeprest_tpu.serve.predictor import Predictor
+
+    w, f, e, h = 16, 32, 3, 64
+    mc = ModelConfig(feature_dim=f, num_metrics=e, hidden_size=h,
+                     dropout_rate=0.0)
+    model = QuantileGRU(config=mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, w, f), np.float32),
+                        deterministic=True)["params"]
+    return Predictor(
+        params, mc,
+        x_stats=MinMaxStats(min=np.float32(0.0), max=np.float32(1.0)),
+        y_stats=MinMaxStats(min=np.zeros((e,), np.float32),
+                            max=np.ones((e,), np.float32)),
+        metric_names=[f"c{i}_cpu" for i in range(e)],
+        window_size=w, ladder=(8,))
+
+
+def measure_overhead_serve(quick: bool) -> dict:
+    """The REQUEST hot path A/B: predict_series throughput with the
+    monitor's per-bucket observe() riding every request (a conservative
+    1:1 bucket:request ratio — real planes see many requests per 5s
+    bucket) vs without.  Sweeps are deliberately NOT in this loop: they
+    run at the bucket-clock cadence, so their cost amortizes over wall
+    time, not over requests — measure_overhead_sweep accounts them."""
+    pred = _build_predictor()
+    w = pred.window_size
+    rng = np.random.default_rng(0)
+    series = rng.random((w * 10, pred.feature_dim), np.float32)
+    calls = 30 if quick else 120
+
+    from deeprest_tpu.config import QualityConfig
+    from deeprest_tpu.obs.quality import QualityMonitor
+
+    qc = QualityConfig(enabled=True, live_window=64, min_sweep_buckets=w)
+    monitor = QualityMonitor([f"c{i}_cpu" for i in range(3)], qc)
+
+    def run(monitored: bool):
+        for _ in range(calls):
+            pred.predict_series(series)
+            if monitored:
+                cols = np.array([1, 5, 9], np.int32)
+                vals = rng.poisson(6.0, size=3).astype(np.float32) + 1.0
+                monitor.observe(cols, vals,
+                                np.asarray([8.0, 8.0, 8.0], np.float32))
+
+    run(False)                                      # warm the jit cache
+    rates = {False: [], True: []}
+    trials = 3 if quick else 5
+    for _ in range(trials):
+        for monitored in (False, True):
+            t0 = time.perf_counter()
+            run(monitored)
+            rates[monitored].append(
+                calls / (time.perf_counter() - t0))
+    off = statistics.median(rates[False])
+    on = statistics.median(rates[True])
+    return {"off_calls_per_sec": round(off, 2),
+            "on_calls_per_sec": round(on, 2),
+            "overhead_pct": round(max(0.0, (off / on - 1.0) * 100.0), 3)}
+
+
+def measure_overhead_sweep(quick: bool,
+                           bucket_seconds: float = 5.0,
+                           sweep_every: int = 30) -> dict:
+    """The bucket-clock half of the budget: per-observe and per-sweep
+    wall costs, amortized at the PRODUCTION cadence — buckets arrive on
+    the collector's scrape clock (5s, the reference contract), so a
+    sweep every ``sweep_every`` buckets costs ``sweep_s`` out of
+    ``sweep_every * bucket_seconds`` of wall time.  A back-to-back A/B
+    (zero inter-arrival) would charge the monitors for time the plane
+    does not spend — that saturated number is reported by the quick
+    tier's stream arms implicitly (their wall_s includes every sweep),
+    never as the budget claim."""
+    from deeprest_tpu.config import QualityConfig
+    from deeprest_tpu.obs.quality import QualityMonitor
+
+    pred = _build_predictor()
+    w = pred.window_size
+    qc = QualityConfig(enabled=True, sweep_every_buckets=sweep_every,
+                       live_window=64, reference_window=64,
+                       min_sweep_buckets=w)
+    monitor = QualityMonitor([f"c{i}_cpu" for i in range(3)], qc)
+    rng = np.random.default_rng(0)
+
+    def one_observe():
+        cols = np.array([1, 5, 9], np.int32)
+        vals = rng.poisson(6.0, size=3).astype(np.float32) + 1.0
+        monitor.observe(cols, vals,
+                        np.asarray([8.0, 8.0, 8.0], np.float32))
+
+    n_obs = 500 if quick else 2000
+    t0 = time.perf_counter()
+    for _ in range(n_obs):
+        one_observe()
+    observe_s = (time.perf_counter() - t0) / n_obs
+    monitor.rebase_reference()
+    monitor.sweep(pred)                             # warm the sweep path
+    sweeps = 5 if quick else 15
+    costs = []
+    for _ in range(sweeps):
+        t0 = time.perf_counter()
+        out = monitor.sweep(pred)
+        costs.append(time.perf_counter() - t0)
+        assert out["armed"]
+    sweep_s = statistics.median(costs)
+    amortized = 100.0 * (observe_s + sweep_s / sweep_every) \
+        / bucket_seconds
+    return {"observe_us": round(observe_s * 1e6, 1),
+            "sweep_ms": round(sweep_s * 1e3, 2),
+            "bucket_seconds": bucket_seconds,
+            "sweep_every_buckets": sweep_every,
+            "overhead_pct": round(amortized, 4)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1 smoke: small corpora, relaxed budget")
+    ap.add_argument("--headline", action="store_true",
+                    help="print one JSON line for bench.py (schema v10)")
+    ap.add_argument("--skip-overhead", action="store_true")
+    args = ap.parse_args(argv)
+
+    budget = QUICK_BUDGET_PCT if args.quick else BUDGET_PCT
+    t0 = time.perf_counter()
+    detection = measure_detection(args.quick)
+    ransomware = measure_ransomware_mid_drift(args.quick)
+    clean = measure_clean(args.quick)
+    overhead = None
+    if not args.skip_overhead:
+        overhead = {
+            "serve": measure_overhead_serve(args.quick),
+            "sweep": measure_overhead_sweep(args.quick),
+            "budget_pct": budget,
+        }
+        overhead["overhead_pct"] = max(
+            overhead["serve"]["overhead_pct"],
+            overhead["sweep"]["overhead_pct"])
+
+    record = {
+        "bench": "drift_bench",
+        "mode": "quick" if args.quick else "full",
+        "detection": detection,
+        "ransomware_mid_drift": ransomware,
+        "clean": clean,
+        "overhead": overhead,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.headline:
+        print(json.dumps({
+            "drift_detection_sweeps": detection["detection_sweeps"],
+            "drift_overhead_pct": (overhead["overhead_pct"]
+                                   if overhead else None),
+        }))
+    else:
+        print(json.dumps(record, indent=2, sort_keys=True))
+
+    # the gates
+    failures = []
+    for name, arm in (("detection", detection),
+                      ("ransomware_mid_drift", ransomware),
+                      ("clean", clean)):
+        if not arm["ok"]:
+            failures.append(name)
+    if overhead is not None and overhead["overhead_pct"] > budget:
+        failures.append(
+            f"overhead {overhead['overhead_pct']}% > {budget}%")
+    if failures:
+        print(f"drift_bench GATES FAILED: {failures}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

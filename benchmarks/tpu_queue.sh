@@ -60,8 +60,12 @@ else
   # measured), and the 2-epoch smoke runs were undertrained — the deep
   # model needs the epochs to beat the baselines it is being judged
   # against.
+  # --sparse-feed: the round-15 padded-COO feed — ~80x fewer staged
+  # bytes at F=10240, losses bit-identical to dense (ROADMAP item 6
+  # named this arm as owed to the dossier).
   step accuracy 14400 python benchmarks/accuracy_dossier.py \
-    --features benchmarks/data/month_10k_features.npz --epochs 12
+    --features benchmarks/data/month_10k_features.npz --epochs 12 \
+    --sparse-feed
 fi
 # --coalesce (round 11): the window-coalescing G sweep at production
 # bf16 — G in {1,2,4,8} window batches folded into the recurrence's row
@@ -117,6 +121,15 @@ step chaos_storm 1800 env JAX_PLATFORMS=tpu python \
 # number so the budget claim covers the production backend too.
 step obs_overhead 900 env JAX_PLATFORMS=tpu python \
   benchmarks/obs_bench.py --out benchmarks/obs_bench_tpu.json
+# Drift-monitor overhead on-chip (round 18): the committed CPU
+# drift_bench.json proves detection/verdict quality and the <=3% budget
+# where sweeps compete with serving for one host core; on the
+# accelerator the sweep's model dispatches ride the device, so the
+# monitor cost on the serve/train hot paths should shrink further —
+# bank it next to obs_overhead so the budget claim covers the
+# production backend.
+step drift_overhead 1200 env JAX_PLATFORMS=tpu python \
+  benchmarks/drift_bench.py --out benchmarks/drift_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

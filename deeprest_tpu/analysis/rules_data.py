@@ -38,10 +38,16 @@ class DN001DenseTrafficMaterialization(Rule):
               "allocation; the pinned dense REFERENCE paths carry "
               "reasoned suppressions instead of silent exemptions")
 
-    # Watchlist: the two modules the sparse-first migration converted.
-    # Component-wise suffix match (the JX003 lesson: bare-name lists
-    # silently exempt moved files).
+    # Watchlist: the two modules the sparse-first migration converted,
+    # plus ALL of obs/ (round 18: the quality monitors touch the F-wide
+    # feature space on every sweep — their contract is COO rows in with
+    # the one dense window built through ops/densify.py, so a dense
+    # per-sweep allocation here is exactly the regression DN001 exists
+    # to catch).  Component-wise suffix match (the JX003 lesson:
+    # bare-name lists silently exempt moved files).
     WATCH = (("train", "stream.py"), ("data", "featurize.py"))
+    # Directory components watched wholesale (any file under them).
+    WATCH_DIRS = ("obs",)
 
     _ALLOCS = {"np.zeros", "np.empty", "np.ones", "np.full",
                "numpy.zeros", "numpy.empty", "numpy.ones", "numpy.full"}
@@ -52,6 +58,8 @@ class DN001DenseTrafficMaterialization(Rule):
 
     def _is_hot(self, rel: str) -> bool:
         parts = tuple(rel.replace("\\", "/").split("/"))
+        if any(d in parts[:-1] for d in self.WATCH_DIRS):
+            return True
         return any(parts[-2:] == w or parts[-len(w):] == w
                    for w in self.WATCH if len(parts) >= len(w))
 

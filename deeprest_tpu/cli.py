@@ -152,13 +152,48 @@ def _superstep_arg(v: str):
 
 
 def cmd_simulate(args) -> int:
-    from deeprest_tpu.data.schema import save_raw_data_pickle
+    from deeprest_tpu.data.schema import (
+        save_raw_data_jsonl, save_raw_data_pickle,
+    )
     from deeprest_tpu.workload.scenarios import SCENARIOS
     from deeprest_tpu.workload.simulator import (
-        build_synthetic_app, simulate_corpus, write_corpus_jsonl,
+        build_shifted_app, build_synthetic_app, simulate_corpus,
+        simulate_drift_corpus_iter, write_corpus_jsonl,
     )
 
     scenario = SCENARIOS[args.scenario](args.seed)
+    if args.shift_at:
+        # mid-corpus topology change (services added/removed — the drift
+        # scenario library; workload/simulator.py owns the generator)
+        if args.app != "synthetic":
+            sys.exit("error: --shift-at needs --app synthetic (the "
+                     "social topology is fixed)")
+        after_n = (args.services_after if args.services_after is not None
+                   else args.services + max(args.services // 2, 1))
+        before, after, endpoints = build_shifted_app(
+            scenario, args.services, after_n, args.endpoints, args.seed)
+        it = simulate_drift_corpus_iter(scenario, args.ticks,
+                                        args.shift_at, before, after,
+                                        endpoints)
+        if args.out.endswith((".jsonl", ".jsl")):
+            n = 0
+
+            def counted():
+                nonlocal n
+                for b in it:
+                    n += 1
+                    yield b
+
+            save_raw_data_jsonl(counted(), args.out)
+        else:
+            buckets = list(it)
+            save_raw_data_pickle(buckets, args.out)
+            n = len(buckets)
+        print(json.dumps({"scenario": args.scenario, "buckets": n,
+                          "app": args.app, "shift_at": args.shift_at,
+                          "services": [args.services, after_n],
+                          "out": args.out}))
+        return 0
     app = endpoints = None
     if args.app == "synthetic":
         app, endpoints = build_synthetic_app(scenario, args.services,
@@ -472,6 +507,18 @@ def cmd_stream(args) -> int:
               "Jaeger/Prometheus source, not --raw JSONL")
         return 2
 
+    from deeprest_tpu.config import QualityConfig
+
+    quality = None
+    if args.drift_detect:
+        quality = QualityConfig(
+            enabled=True,
+            sweep_every_buckets=args.drift_sweep_every,
+            live_window=args.drift_live_window,
+            reference_window=args.drift_reference_window,
+            drift_enter=args.drift_enter, drift_exit=args.drift_exit,
+            auto_retrain=not args.no_drift_auto_retrain,
+            retrain_cooldown_buckets=args.drift_cooldown_buckets)
     cfg = Config(
         model=ModelConfig(feature_dim=args.capacity,
                           hidden_size=args.hidden_size,
@@ -488,6 +535,7 @@ def cmd_stream(args) -> int:
                           snapshot_every_steps=args.snapshot_every_steps),
         etl=EtlConfig(overlap=not args.no_etl_overlap,
                       queue_depth=args.etl_queue_depth),
+        quality=quality or QualityConfig(),
     )
     st = StreamingTrainer(
         cfg,
@@ -515,18 +563,34 @@ def cmd_stream(args) -> int:
             bucket_s=args.bucket_seconds, resource_map=rmap)
     else:
         tailer = BucketTailer(args.raw)
+    controller = None
+    if quality is not None:
+        from deeprest_tpu.train.stream import DriftController
+
+        controller = DriftController(st, quality)
     for r in st.run(tailer,
                     max_refreshes=args.max_refreshes or None,
                     deadline_s=args.deadline or None):
-        print(json.dumps({
+        rec = {
             "refresh": r.refresh, "buckets": r.num_buckets,
             "train_loss": round(r.train_loss, 6),
             "eval_loss": round(r.eval_loss, 6),
             "checkpoint": r.checkpoint_path,
+            "trigger": r.trigger,
             "etl": {"stall_s": round(r.etl_stall_s, 4),
                     "lag_buckets": r.etl_lag_buckets,
                     "dropped": r.etl_dropped},
-        }), flush=True)
+        }
+        if controller is not None and controller.monitor is not None:
+            v = controller.monitor.verdicts()
+            rec["quality"] = {"states": v.get("states"),
+                              "feature_drift":
+                                  v["feature_drift"].get("state"),
+                              "psi": v["feature_drift"].get("psi"),
+                              **{k: controller.stats[k]
+                                 for k in ("sweeps",
+                                           "retrains_triggered")}}
+        print(json.dumps(rec), flush=True)
     return 0
 
 
@@ -803,6 +867,25 @@ def cmd_serve(args) -> int:
 
     service = PredictionService(pred, synthesizer, backend=backend,
                                 reloader=reloader, batching=batching)
+    if args.verdict_raw:
+        from deeprest_tpu.config import QualityConfig
+        from deeprest_tpu.obs.quality import QualityMonitor
+        from deeprest_tpu.serve.server import VerdictIngestor
+        from deeprest_tpu.train.stream import BucketTailer
+
+        space = pred.space()
+        if space is None:
+            sys.exit("error: model has no feature space; the verdict "
+                     "surface needs the training-time call-path space to "
+                     "featurize the tailed corpus")
+        monitor = QualityMonitor(
+            list(pred.metric_names),
+            QualityConfig(enabled=True,
+                          sweep_every_buckets=args.verdict_sweep_every,
+                          live_window=args.verdict_live_window))
+        ingestor = VerdictIngestor(service, BucketTailer(args.verdict_raw),
+                                   space, monitor).start()
+        service.attach_quality(monitor, ingestor)
     server = PredictionServer(service, host=args.host, port=args.port)
     host, port = server.address
     print(json.dumps({"listening": f"http://{host}:{port}",
@@ -810,6 +893,9 @@ def cmd_serve(args) -> int:
                       "whatif": synthesizer is not None,
                       "replicas": args.replicas,
                       "autoscale": autoscaler is not None,
+                      "verdict": ({"raw": args.verdict_raw,
+                                   "sweep_every": args.verdict_sweep_every}
+                                  if args.verdict_raw else None),
                       "obs": {"spans": not args.no_obs,
                               "span_capacity": args.obs_span_capacity,
                               "metrics": "/metrics"},
@@ -1126,6 +1212,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="synthetic app: number of services")
     p.add_argument("--endpoints", type=int, default=12,
                    help="synthetic app: number of API endpoints")
+    p.add_argument("--shift-at", type=int, default=0,
+                   help="mid-corpus topology change: buckets at/after "
+                        "this index generate from a re-drawn synthetic "
+                        "topology with --services-after services (0 = no "
+                        "shift; the drift-scenario library)")
+    p.add_argument("--services-after", type=int, default=None,
+                   help="post-shift service count (default: --services "
+                        "+ 50%%)")
     p.set_defaults(fn=cmd_simulate)
 
     p = sub.add_parser("featurize", help="raw corpus → model-ready features")
@@ -1334,6 +1428,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after N refreshes (0 = run forever)")
     p.add_argument("--deadline", type=float, default=0,
                    help="stop after this many seconds (0 = no deadline)")
+    p.add_argument("--drift-detect", action="store_true",
+                   help="arm the online quality monitors + the "
+                        "drift→retrain loop (obs/quality.py, "
+                        "DriftController): streaming per-call-path "
+                        "PSI/KS vs the training reference, rolling band "
+                        "coverage/pinball, the continuous "
+                        "not-justified-by-traffic check, and "
+                        "auto-retrain on sustained drift")
+    p.add_argument("--drift-sweep-every", type=int, default=30,
+                   metavar="N", help="buckets between monitor sweeps")
+    p.add_argument("--drift-live-window", type=int, default=120,
+                   metavar="N",
+                   help="trailing buckets the drift score compares "
+                        "against the training reference")
+    p.add_argument("--drift-reference-window", type=int, default=240,
+                   metavar="N",
+                   help="retained-ring tail re-anchored as the drift "
+                        "reference after each (re)train")
+    p.add_argument("--drift-enter", type=float, default=0.25,
+                   help="weighted-PSI threshold entering the drift "
+                        "verdict (sustained sweeps required — "
+                        "hysteresis)")
+    p.add_argument("--drift-exit", type=float, default=0.10,
+                   help="weighted-PSI threshold exiting the drift "
+                        "verdict")
+    p.add_argument("--drift-cooldown-buckets", type=int, default=240,
+                   metavar="N",
+                   help="minimum buckets between drift-triggered "
+                        "retrains")
+    p.add_argument("--no-drift-auto-retrain", action="store_true",
+                   help="manual override: verdicts only — sustained "
+                        "drift never fires a retrain by itself")
     p.set_defaults(fn=cmd_stream)
 
     p = sub.add_parser("whatif",
@@ -1473,6 +1599,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bound on retained spans (newest win; GET "
                         "/v1/spans exports them as Jaeger JSON for the "
                         "self-ingestion loop)")
+    p.add_argument("--verdict-raw", default=None, metavar="PATH",
+                   help="arm the streaming verdict surface (GET "
+                        "/v1/verdict): tail this growing collector JSONL, "
+                        "featurize against the served model's call-path "
+                        "space, and run the online quality monitors "
+                        "(drift PSI/KS, band coverage/pinball, the "
+                        "continuous not-justified-by-traffic check) — "
+                        "the streaming replacement for the batch anomaly "
+                        "CLI")
+    p.add_argument("--verdict-sweep-every", type=int, default=30,
+                   metavar="N",
+                   help="buckets between verdict-surface monitor sweeps")
+    p.add_argument("--verdict-live-window", type=int, default=120,
+                   metavar="N",
+                   help="trailing buckets in the drift live window (also "
+                        "the auto-arm reference size)")
     _add_fused_infer_args(p)
     _add_sparse_args(p, serving=True)
     _add_mesh_arg(p, serving=True)

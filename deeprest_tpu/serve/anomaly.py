@@ -18,6 +18,21 @@ from deeprest_tpu.serve.predictor import Predictor
 
 
 @dataclasses.dataclass
+class AlignedBands:
+    """The detector's aligned comparison space — everything downstream of
+    the model call and upstream of the excess/flag logic.  Shared by the
+    batch :meth:`AnomalyDetector.check` path and the streaming quality
+    monitor (obs/quality.py), which additionally reads band coverage and
+    pinball loss off the same aligned arrays, so the continuous verdict
+    surface and the batch CLI agree by construction."""
+
+    preds: np.ndarray          # [T, E, Q] monotone-rearranged, re-anchored
+    observed: np.ndarray       # [T, E] adjusted (delta metrics differenced)
+    upper: np.ndarray          # [T, E] the band's upper envelope
+    scale: np.ndarray          # [T, E] the floored normalization scale
+
+
+@dataclasses.dataclass
 class AnomalyReport:
     metric: str
     score: float               # mean normalized excess above the upper band
@@ -61,7 +76,15 @@ class AnomalyDetector:
         self.min_run = min_run
         self.reanchor_resources = reanchor_resources
 
-    def check(self, traffic: np.ndarray, observed: np.ndarray) -> list[AnomalyReport]:
+    def check(self, traffic: np.ndarray,
+              observed: np.ndarray) -> list[AnomalyReport]:
+        """``aligned`` + ``reports`` in one call (the batch CLI path;
+        the streaming monitor calls the halves separately so calibration
+        can read the same aligned bands without a second model pass)."""
+        return self.reports(self.aligned(traffic, observed))
+
+    def aligned(self, traffic: np.ndarray,
+                observed: np.ndarray) -> AlignedBands:
         """traffic: [T, F] feature series; observed: [T, E] de-normalized
         utilization aligned with ``predictor.metric_names``.
 
@@ -146,6 +169,12 @@ class AnomalyDetector:
             fallback = float(np.max(floor)) if np.max(floor) > 0 else 1.0
             floor = np.where(floor > 0, floor, fallback)
             scale[:, dm] = np.maximum(scale[:, dm], floor)
+        return AlignedBands(preds=preds, observed=observed, upper=upper,
+                            scale=scale)
+
+    def reports(self, bands: AlignedBands) -> list[AnomalyReport]:
+        """The excess/flag half over an aligned comparison space."""
+        observed, upper, scale = bands.observed, bands.upper, bands.scale
         excess = np.maximum(observed - upper - self.tolerance * scale,
                             0.0) / scale
 

@@ -445,6 +445,13 @@ def _worker_main(spec: dict, conn) -> None:
         "median_index": backend.median_index(),
         "delta_mask": (np.asarray(backend.delta_mask, bool).tolist()
                        if backend.delta_mask is not None else None),
+        # y_stats ride the handshake so the router can serve the full
+        # AnomalyDetector protocol (scale floors for re-anchored/delta
+        # metrics) — the streaming verdict surface sweeps THROUGH the
+        # router, same as /v1/anomaly.
+        "y_stats": (backend.y_stats.to_dict()
+                    if getattr(backend, "y_stats", None) is not None
+                    else None),
     }))
     send_lock = threading.Lock()
 

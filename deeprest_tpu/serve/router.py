@@ -366,7 +366,12 @@ class ReplicaRouter:
             "deeprest_router_rejoins_total",
             "ejected replicas probed healthy and re-admitted to dispatch",
             labelnames=("replica",))
-        for m in (self._m_ejections, self._m_retries, self._m_rejoins):
+        self._m_reloads_by_reason = obs_metrics.Counter(
+            "deeprest_router_reloads_by_reason_total",
+            "rolling reloads by trigger (watch/drift/manual)",
+            labelnames=("reason",))
+        for m in (self._m_ejections, self._m_retries, self._m_rejoins,
+                  self._m_reloads_by_reason):
             obs_metrics.REGISTRY.expose(m)
         self._meta = self._probe_meta(replicas[0])
         # Render-time /metrics view over the replica plane: everything it
@@ -390,8 +395,14 @@ class ReplicaRouter:
                 "delta_mask": (np.asarray(b.delta_mask, bool)
                                if b.delta_mask is not None else None),
                 "space_dict": getattr(b, "space_dict", None),
+                "y_stats": getattr(b, "y_stats", None),
             }
         meta = replica._meta            # ProcessReplica boot handshake
+        y_stats = None
+        if meta.get("y_stats") is not None:
+            from deeprest_tpu.data.windows import MinMaxStats
+
+            y_stats = MinMaxStats.from_dict(meta["y_stats"])
         return {
             "metric_names": list(meta["metric_names"]),
             "window_size": int(meta["window_size"]),
@@ -401,6 +412,7 @@ class ReplicaRouter:
             "delta_mask": (np.asarray(meta["delta_mask"], bool)
                            if meta.get("delta_mask") is not None else None),
             "space_dict": meta.get("space_dict"),
+            "y_stats": y_stats,
         }
 
     # -- construction ----------------------------------------------------
@@ -496,6 +508,14 @@ class ReplicaRouter:
     @property
     def space_dict(self):
         return self._meta_get("space_dict")
+
+    @property
+    def y_stats(self):
+        """Target normalization stats (the AnomalyDetector's scale-floor
+        source) — probed from the lead replica like the rest of the
+        metadata, so the detector and the streaming verdict surface run
+        over the router exactly as over one Predictor."""
+        return self._meta_get("y_stats")
 
     def median_index(self) -> int:
         return self._meta_get("median_index")
@@ -751,11 +771,24 @@ class ReplicaRouter:
             seen.add(key)
             r.set_batching(config)
 
-    def rolling_reload_from(self, fresh_backend) -> None:
+    def rolling_reload_from(self, fresh_backend,
+                            reason: str = "watch") -> None:
         """Zero-downtime reload: drain → swap → re-admit, one stack at a
         time.  Replicas sharing a stack (same device) drain together and
         swap once.  Never takes the router lock across a drain wait —
-        requests keep flowing to the other replicas."""
+        requests keep flowing to the other replicas.
+
+        ``reason`` labels the reload's obs counter and span — "watch"
+        (checkpoint-dir follower), "drift" (DriftController hot-swap), or
+        "manual" — so the drift→retrain→reload loop is distinguishable
+        from cadence reloads on /metrics."""
+        with obs_spans.RECORDER.span("router.rolling_reload",
+                                     component="deeprest-router") as sp:
+            sp.tag(reason=reason)
+            self._rolling_reload_inner(fresh_backend)
+        self._m_reloads_by_reason.inc(reason=reason)
+
+    def _rolling_reload_inner(self, fresh_backend) -> None:
         with self._lock:
             replicas = list(self._replicas)
         groups: dict[int, list] = {}

@@ -218,6 +218,11 @@ class PredictionService:
         self.reloads = 0
         self.batcher: MicroBatcher | None = None
         self.batching = None
+        # Streaming verdict surface (obs/quality.py): attach_quality wires
+        # a QualityMonitor (+ optional VerdictIngestor feeding it from the
+        # collector JSONL); GET /v1/verdict renders its state.
+        self.quality = None
+        self._quality_ingestor = None
         self.whatif = (WhatIfEstimator(predictor, synthesizer)
                        if synthesizer is not None else None)
         if batching is not None:
@@ -261,6 +266,17 @@ class PredictionService:
         if old is not None:
             old.close()               # drain outside the lock
 
+    def attach_quality(self, monitor, ingestor=None) -> None:
+        """Wire the streaming verdict surface: ``monitor`` backs
+        ``GET /v1/verdict`` (and the deeprest_quality_* /metrics gauges
+        it publishes); ``ingestor`` (a started VerdictIngestor) is owned
+        by the service from here — close() stops it."""
+        with self._lock:
+            self.quality = monitor
+            old, self._quality_ingestor = self._quality_ingestor, ingestor
+        if old is not None:
+            old.stop()
+
     def close(self) -> None:
         """Release the batcher's worker thread (idempotent).  Tolerates
         minimal test/protocol backends that implement only the read-side
@@ -270,6 +286,9 @@ class PredictionService:
             old, self.batcher = self.batcher, None
             self.batching = None
             pred = self.predictor
+            ingestor, self._quality_ingestor = self._quality_ingestor, None
+        if ingestor is not None:
+            ingestor.stop()
         detach = getattr(pred, "attach_batcher", None)
         if callable(detach):
             detach(None)
@@ -449,7 +468,30 @@ class PredictionService:
         # retention, eviction pressure — the JSON twin of the /metrics
         # deeprest_obs_* gauges
         out["obs"] = obs_spans.RECORDER.stats()
+        with self._lock:
+            quality = self.quality
+        if quality is not None:
+            # model-quality surface summary (additive key; the full
+            # per-metric verdict table lives at GET /v1/verdict)
+            v = quality.verdicts()
+            out["quality"] = {"armed": v.get("armed", False),
+                              "sweeps": v.get("sweeps", 0),
+                              "states": v.get("states")}
         return out
+
+    def verdict(self) -> dict:
+        """``GET /v1/verdict`` — the streaming per-(component,resource)
+        ``ok|drift|anomaly`` surface (obs/quality.py), replacing the
+        batch-only anomaly CLI path for live planes.  503 when no monitor
+        is attached (serve with --verdict-raw)."""
+        with self._lock:
+            quality = self.quality
+        if quality is None:
+            raise ServingError(
+                "no quality monitor attached: start the server with "
+                "--verdict-raw <collector jsonl> (or attach_quality) to "
+                "enable the streaming verdict surface", status=503)
+        return quality.verdicts()
 
     def meta(self) -> dict:
         pred, whatif, _, _ = self._snapshot()
@@ -575,8 +617,122 @@ class PredictionService:
         } for r in reports], "flagged": [r.metric for r in reports if r.flagged]}
 
 
+class VerdictIngestor:
+    """Feed the serving plane's QualityMonitor from the collector's raw
+    JSONL — the serve-side half of the streaming verdict surface.
+
+    A daemon thread tails the same growing file the streaming trainer
+    tails (train/stream.BucketTailer), featurizes each bucket against the
+    SERVED model's call-path space (``predictor.space()`` — column-exact
+    with training by construction), and feeds the monitor; every
+    ``sweep_every_buckets`` buckets it runs a quality sweep THROUGH the
+    current serving backend snapshot (single predictor or the replica
+    router — the sweep's model calls ride the ordinary dispatch path, so
+    the ≤3% monitor budget covers real serving cost).
+
+    Reference handling: the drift reference auto-arms from the first
+    ``live_window`` tailed buckets ("the stream you trusted at attach
+    time"), and RE-ANCHORS whenever the service hot-reloads a new
+    checkpoint (the fresh params trained on recent data, so recent data
+    is the new no-drift baseline) — which also restarts the
+    model-conditioned calibration/anomaly streams via
+    ``on_model_refresh``, making post-reload band-coverage recovery
+    visible instead of averaged into the stale model's tail.
+    """
+
+    def __init__(self, service: PredictionService, tailer, space, monitor,
+                 poll_interval_s: float = 0.5):
+        self._service = service
+        self._tailer = tailer               # ingestor-thread-owned
+        self._space = space
+        self.monitor = monitor              # carries its own lock
+        self._poll_interval_s = float(poll_interval_s)
+        self._stop = threading.Event()
+        # Guards the error counter (read by tests/healthz from handler
+        # threads while the ingestor thread increments) and the thread
+        # handle across start/stop (TH001 discipline).
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._errors = 0
+
+    def start(self) -> "VerdictIngestor":
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="deeprest-verdict-ingest")
+        with self._lock:
+            self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        close = getattr(self._tailer, "close", None)
+        if callable(close):
+            close()
+
+    @property
+    def errors(self) -> int:
+        with self._lock:
+            return self._errors
+
+    # -- the loop (ingestor thread only; cross-iteration state lives in
+    # locals so nothing here is shared off-lock) -------------------------
+
+    def _loop(self) -> None:
+        since_sweep = 0
+        last_reloads: int | None = None
+        while not self._stop.is_set():
+            try:
+                got = self._tailer.poll()
+                for bucket in got:
+                    cols, vals = self._space.extract_sparse(bucket.traces)
+                    self.monitor.observe(
+                        cols, vals,
+                        {m.key: m.value for m in bucket.metrics})
+                    since_sweep += 1
+                last_reloads = self._maybe_rebase(last_reloads)
+                if (self.monitor.drift.ready and since_sweep
+                        >= self.monitor.config.sweep_every_buckets):
+                    since_sweep = 0
+                    pred = self._service._snapshot()[0]
+                    self.monitor.sweep(pred)
+            except Exception as exc:
+                # A malformed bucket or a mid-reload model error must not
+                # kill the surface; count it (scrapeable) and keep
+                # tailing — the first occurrence is printed for triage.
+                with self._lock:
+                    self._errors += 1
+                    first = self._errors == 1
+                obs_metrics.REGISTRY.counter(
+                    "deeprest_verdict_ingest_errors_total",
+                    "verdict-ingest loop errors (kept running)").inc()
+                if first:
+                    print(f"verdict-ingest: {type(exc).__name__}: {exc}")
+            if not getattr(self._tailer, "backlog", False):
+                self._stop.wait(self._poll_interval_s)
+
+    def _maybe_rebase(self, last_reloads: int | None) -> int:
+        cfg = self.monitor.config
+        reloads = self._service._snapshot()[3]   # lock-protected read
+        if last_reloads is not None and reloads != last_reloads:
+            # a fresh checkpoint rolled in: recent traffic is the new
+            # no-drift baseline, and calibration/anomaly restart against
+            # the fresh band
+            if self.monitor.observed_buckets >= cfg.min_sweep_buckets:
+                self.monitor.rebase_reference()
+            self.monitor.on_model_refresh()
+            return reloads
+        if (not self.monitor.drift.ready
+                and self.monitor.observed_buckets >= cfg.live_window):
+            self.monitor.rebase_reference()     # auto-arm
+        return reloads
+
+
 _GET_ROUTES = {"/healthz": "healthz", "/v1/meta": "meta",
-               "/v1/spans": "spans_jaeger"}
+               "/v1/spans": "spans_jaeger", "/v1/verdict": "verdict"}
 _POST_ROUTES = {
     "/v1/predict": "predict",
     "/v1/whatif": "whatif_estimate",
@@ -647,6 +803,9 @@ class PredictionServer:
                 try:
                     outer.service.maybe_reload()
                     self._reply(200, getattr(outer.service, name)())
+                except ServingError as e:   # e.g. /v1/verdict unattached
+                    self._reply(e.status, {"error": str(e)},
+                                headers=e.headers)
                 except Exception as e:  # never drop the connection silently
                     self._reply(500, {"error": f"internal: {e}"})
 

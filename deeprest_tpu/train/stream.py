@@ -74,6 +74,7 @@ import numpy as np
 from deeprest_tpu.config import Config, FeaturizeConfig
 from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
 from deeprest_tpu.data.schema import Bucket
 from deeprest_tpu.data.windows import MinMaxStats, minmax_fit, sliding_windows
 from deeprest_tpu.ops.densify import sparse_minmax
@@ -328,6 +329,10 @@ class RefreshResult:
     train_loss: float
     eval_loss: float
     checkpoint_path: str | None
+    # What fired this refresh: "cadence" (the refresh_buckets counter),
+    # "drift" (DriftController auto-trigger), or "manual"
+    # (DriftController.force_retrain).
+    trigger: str = "cadence"
     # Host-ETL health counters (filled by run(); zero for direct refresh()
     # calls).  etl_stall_s is the train thread's host-ETL cost since the
     # previous refresh: with overlap OFF it is time spent featurizing
@@ -414,6 +419,11 @@ class StreamingTrainer:
         # to this config's delta_resources would collapse the normalized
         # range and cumsum level-scale outputs.
         self._resumed_delta_mask: np.ndarray | None = None
+        # The drift→retrain loop (DriftController via attach_quality):
+        # on_bucket fires after every ingest, on_refresh after every
+        # fine-tune; request_refresh() below is its trigger.
+        self.quality: "DriftController | None" = None
+        self._force_refresh: str | None = None
         self._maybe_resume()
 
     # -- ingestion ------------------------------------------------------
@@ -423,13 +433,17 @@ class StreamingTrainer:
             # The sparse ingest never touches a [capacity]-wide buffer:
             # extract_sparse returns the bucket's (cols, counts) pair and
             # the ring stores it padded to the K cap.
-            self.traffic.append_sparse(*self.space.extract_sparse(
-                bucket.traces))
+            row = self.space.extract_sparse(bucket.traces)
+            self.traffic.append_sparse(*row)
         else:
             # extract(out=...) fills the ring's next slot in place: no
             # fresh [capacity] float32 per bucket on the poll thread.
-            self.space.extract(bucket.traces, out=self.traffic.append_slot())
-        self._commit_metrics({m.key: m.value for m in bucket.metrics})
+            row = self.space.extract(bucket.traces,
+                                     out=self.traffic.append_slot())
+        metrics_row = {m.key: m.value for m in bucket.metrics}
+        self._commit_metrics(metrics_row)
+        if self.quality is not None:
+            self.quality.on_bucket(row, metrics_row)
 
     def _featurize(self, bucket: Bucket) -> tuple:
         """Featurize off the train thread (overlap mode): the returned row
@@ -447,6 +461,8 @@ class StreamingTrainer:
         else:
             self.traffic.append_slot()[:] = row
         self._commit_metrics(metrics_row)
+        if self.quality is not None:
+            self.quality.on_bucket(row, metrics_row)
 
     def _commit_metrics(self, row: dict[str, float]) -> None:
         self.metrics.append(row)
@@ -513,14 +529,40 @@ class StreamingTrainer:
 
     # -- refresh --------------------------------------------------------
 
+    def attach_quality(self, controller: "DriftController") -> None:
+        """Wire the drift→retrain loop: ``controller.on_bucket`` fires
+        after every ingest (both ETL modes — ingest happens on the train
+        thread either way), ``controller.on_refresh`` after every
+        fine-tune."""
+        self.quality = controller
+
+    def request_refresh(self, reason: str = "manual") -> None:
+        """Queue an out-of-cadence refresh (the DriftController's
+        trigger): the next readiness check fires a fine-tune regardless
+        of the ``refresh_buckets`` counter, provided the corpus is big
+        enough to train at all.  The reason rides in
+        ``RefreshResult.trigger``."""
+        self._force_refresh = reason
+
+    def current_delta_mask(self) -> np.ndarray:
+        """The delta mask the CURRENT params encode (the resumed
+        checkpoint's when one exists — see refresh())."""
+        if self._resumed_delta_mask is not None:
+            return self._resumed_delta_mask
+        return delta_mask(self._freeze_metrics(),
+                          self.config.train.delta_resources)
+
     def ready(self) -> bool:
         w = self.config.train.window_size
         min_windows = self.stream.eval_holdout + 2
-        return (self._pending >= self.stream.refresh_buckets
-                and self.num_buckets > w + min_windows)
+        due = (self._pending >= self.stream.refresh_buckets
+               or self._force_refresh is not None)
+        return due and self.num_buckets > w + min_windows
 
     def refresh(self) -> RefreshResult:
         """Fine-tune on the retained corpus; returns the refresh record."""
+        trigger, self._force_refresh = (self._force_refresh or "cadence",
+                                        None)
         w = self.config.train.window_size
         # Zero-copy contiguous views of the retained corpus (SeriesRing):
         # assembly is O(1) where the deque-era np.stack + per-dict target
@@ -663,10 +705,16 @@ class StreamingTrainer:
             from deeprest_tpu.train.checkpoint import prune_checkpoints
 
             prune_checkpoints(self.ckpt_dir, self.stream.keep_checkpoints)
-        return RefreshResult(
+        result = RefreshResult(
             refresh=self._refresh_count, num_buckets=self.num_buckets,
             train_loss=train_loss, eval_loss=float(eval_loss),
-            checkpoint_path=path)
+            checkpoint_path=path, trigger=trigger)
+        if self.quality is not None:
+            # After the checkpoint is on disk: the controller re-anchors
+            # the drift reference to what these params just trained on
+            # and (for drift/manual triggers) hot-swaps the serving plane.
+            self.quality.on_refresh(result)
+        return result
 
     # -- preemption snapshots (ROADMAP item 7, dynamic half) ------------
 
@@ -932,6 +980,204 @@ class StreamingTrainer:
             thread.join(timeout=10.0)
 
 
+class DriftController:
+    """The drift→retrain→hot-reload loop over one StreamingTrainer
+    (ROADMAP item 6's act half; obs/quality.py is the detect half).
+
+    Wired via ``trainer.attach_quality(controller)``:
+
+    - every ingested bucket feeds the quality monitor (O(nnz) — the
+      traffic row is already featurized) and advances the sweep cadence;
+    - every ``sweep_every_buckets`` buckets the monitors run over the
+      trailing window (drift PSI/KS, band calibration, the continuous
+      not-justified-by-traffic check) using a :class:`WindowBackend`
+      whose jitted apply takes params as ARGUMENTS — one compiled
+      executable serves every refresh's fresh params (the JX001
+      discipline; a per-refresh Predictor would recompile every cycle);
+    - when the drift verdict is ACTIVE (hysteresis already absorbed
+      noise), ``auto_retrain`` queues an out-of-cadence refresh on the
+      retained rings, bounded by ``retrain_cooldown_buckets`` and
+      suppressed while an anomaly verdict is active (retraining on
+      not-justified-by-traffic consumption would teach the model the
+      very thing the sanity check exists to flag) — every suppression is
+      counted, by reason;
+    - after a drift/manual-triggered refresh lands its checkpoint,
+      ``reload_fn(checkpoint_path)`` hot-swaps the serving plane (the
+      e2e loop passes a closure over
+      ``ReplicaRouter.rolling_reload_from``; a plane watching the
+      checkpoint dir via ``serve --watch`` needs no reload_fn at all);
+    - every decision is observable: obs counters by reason + spans
+      around retrain triggers and reloads.
+
+    Manual override: ``auto_retrain=False`` keeps the verdicts flowing
+    while a human pulls :meth:`force_retrain`.
+    """
+
+    def __init__(self, trainer: StreamingTrainer, config=None,
+                 reload_fn: Callable[[str], None] | None = None,
+                 monitor=None):
+        from deeprest_tpu.config import QualityConfig
+
+        self.config = config or QualityConfig(enabled=True)
+        self._st = trainer
+        self._reload_fn = reload_fn
+        self.monitor = monitor          # built at the first refresh
+        self._apply = None              # jitted once, params as args
+        self._since_sweep = 0
+        self._bucket = 0                # buckets seen by on_bucket
+        self._cooldown_until = -1
+        self.stats = {"sweeps": 0, "retrains_triggered": 0,
+                      "reloads": 0, "suppressed": {}}
+        reg = obs_metrics.REGISTRY
+        self._m_retrains = reg.counter(
+            "deeprest_drift_retrains_total",
+            "out-of-cadence retrains triggered by the drift loop",
+            labelnames=("trigger",))
+        self._m_suppressed = reg.counter(
+            "deeprest_drift_retrain_suppressed_total",
+            "drift-triggered retrains suppressed, by reason",
+            labelnames=("reason",))
+        self._m_reloads = reg.counter(
+            "deeprest_drift_reloads_total",
+            "serving-plane hot reloads pushed by the drift loop")
+        trainer.attach_quality(self)
+
+    # -- StreamingTrainer hooks (train thread only) ----------------------
+
+    def on_bucket(self, row, metrics_row: dict) -> None:
+        self._bucket += 1
+        if self.monitor is None:
+            return                      # arms at the first refresh
+        if isinstance(row, tuple):
+            self.monitor.observe(row[0], row[1], metrics_row)
+        else:
+            self.monitor.observe_dense(row, metrics_row)
+        self._since_sweep += 1
+        if self._since_sweep >= self.config.sweep_every_buckets:
+            self._since_sweep = 0
+            self._sweep()
+
+    def on_refresh(self, result: RefreshResult) -> None:
+        if self.monitor is None:
+            from deeprest_tpu.obs.quality import QualityMonitor
+
+            self.monitor = QualityMonitor(self._st.metric_names,
+                                          self.config)
+        # Cold-start warmup for the model-conditioned verdicts: an
+        # undertrained band's one-sided excess is indistinguishable from
+        # a real anomaly, so calibration/anomaly machines stay disarmed
+        # until the model has matured through enough refreshes.
+        self.monitor.set_model_armed(
+            self._st._refresh_count >= self.config.model_warmup_refreshes)
+        # The fresh params trained on the retained rings — those rows ARE
+        # the new no-drift reference.
+        self.monitor.set_reference(self._ring_rows())
+        if result.trigger in ("drift", "manual"):
+            # Only a DRIFT-triggered retrain restarts the model-
+            # conditioned verdict streams (calibration, anomaly): that is
+            # the disambiguation move — recovery is measured against the
+            # deliberately-refreshed band, and the excess that SURVIVES
+            # it is real anomaly.  Cadence fine-tunes are incremental;
+            # resetting on every one would wipe an anomaly streak faster
+            # than sustain_enter can accumulate it (measured: a
+            # ransomware window spanning many cadence refreshes never
+            # flagged) — exactly the flap the hysteresis exists to stop.
+            self.monitor.on_model_refresh()
+            self._cooldown_until = (self._bucket
+                                    + self.config.retrain_cooldown_buckets)
+            if self._reload_fn is not None and result.checkpoint_path:
+                with obs_spans.RECORDER.span(
+                        "drift.reload", component="deeprest-drift") as sp:
+                    sp.tag(checkpoint=result.checkpoint_path,
+                           trigger=result.trigger)
+                    self._reload_fn(result.checkpoint_path)
+                self.stats["reloads"] += 1
+                self._m_reloads.inc()
+
+    # -- the decide step -------------------------------------------------
+
+    def force_retrain(self) -> None:
+        """Manual trigger: next readiness check fires a refresh."""
+        self._st.request_refresh("manual")
+
+    def _sweep(self) -> None:
+        if self._st.state is None:
+            return
+        summary = self.monitor.sweep(self._backend())
+        if not summary.get("armed"):
+            return
+        self.stats["sweeps"] += 1
+        self._decide()
+
+    def _decide(self) -> None:
+        from deeprest_tpu.obs.quality import VERDICT_ANOMALY, VERDICT_DRIFT
+
+        cfg = self.config
+        if not self.monitor.any_active(VERDICT_DRIFT):
+            return
+        reason = None
+        if not cfg.auto_retrain:
+            reason = "manual-override"
+        elif self._bucket < self._cooldown_until:
+            reason = "cooldown"
+        elif (self.monitor.any_active(VERDICT_ANOMALY)
+              and not cfg.retrain_during_anomaly):
+            reason = "anomaly-active"
+        if reason is not None:
+            self.stats["suppressed"][reason] = \
+                self.stats["suppressed"].get(reason, 0) + 1
+            self._m_suppressed.inc(reason=reason)
+            return
+        if self._st._force_refresh is not None:
+            return                      # a trigger is already queued
+        with obs_spans.RECORDER.span("drift.retrain",
+                                     component="deeprest-drift") as sp:
+            sp.tag(bucket=self._bucket,
+                   psi=round(self.monitor.verdicts()
+                             ["feature_drift"]["psi"], 4))
+            self._st.request_refresh("drift")
+        self.stats["retrains_triggered"] += 1
+        self._m_retrains.inc(trigger="drift")
+
+    # -- plumbing --------------------------------------------------------
+
+    def _ring_rows(self):
+        """The drift-reference rows: the trailing ``reference_window``
+        retained buckets (sparse pairs or dense row views — never a
+        fresh F-wide allocation).  The tail, not the whole ring: the
+        verdict asks whether the live stream differs from what the model
+        most recently trained on, so a retrain that adapted to a new
+        regime re-anchors the reference there and the drift verdict can
+        EXIT instead of forever comparing against a pre/post mixture."""
+        st = self._st
+        n = len(st.traffic)
+        lo = max(0, n - self.config.reference_window)
+        if st.sparse:
+            cols_v, vals_v, nnz_v = st.traffic.view()
+            return [(cols_v[i, :nnz_v[i]], vals_v[i, :nnz_v[i]])
+                    for i in range(lo, n)]
+        view = st.traffic.view()
+        return [view[i] for i in range(lo, n)]
+
+    def _backend(self):
+        from deeprest_tpu.obs.quality import WindowBackend
+
+        if self._apply is None:
+            import jax
+
+            model = self._st.trainer.model
+            self._apply = jax.jit(
+                lambda p, x: model.apply({"params": p}, x,
+                                         deterministic=True))
+        st = self._st
+        return WindowBackend(
+            self._apply, st.state.params, st.x_stats, st.y_stats,
+            st.metric_names, st.config.model.quantiles,
+            st.config.train.window_size,
+            delta_mask=st.current_delta_mask(),
+            feature_dim=st.space.capacity)
+
+
 class _EtlBuffer:
     """Bounded handoff between the ETL thread and the train loop.
 
@@ -1004,6 +1250,6 @@ class _EtlBuffer:
 
 
 __all__ = [
-    "BucketTailer", "StreamConfig", "StreamingTrainer", "RefreshResult",
-    "expand_minmax",
+    "BucketTailer", "DriftController", "StreamConfig", "StreamingTrainer",
+    "RefreshResult", "expand_minmax",
 ]

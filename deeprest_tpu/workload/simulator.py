@@ -241,6 +241,95 @@ def build_synthetic_app(scenario: LoadScenario, num_services: int,
     return app, app.endpoints
 
 
+def build_shifted_app(scenario: LoadScenario, num_services: int,
+                      num_services_after: int, num_endpoints: int,
+                      seed: int):
+    """The mid-corpus topology-change pair (ROADMAP item 6's scenario
+    library): the BEFORE and AFTER synthetic topologies of a rolling
+    deployment that adds/removes services.
+
+    Both apps share the seed and the endpoint surface (names are
+    ``/api/epNN``, so the scenario's traffic matrix stays valid across
+    the shift), but a different ``num_services`` re-draws the layered
+    DAG — services appear, vanish, and rewire, which is exactly the
+    call-path composition shift the drift monitors must flag (new hash
+    columns gain mass, old ones go dark)."""
+    from deeprest_tpu.workload.microtopo import (
+        SyntheticMicroserviceApp, TopologyParams,
+    )
+
+    before = SyntheticMicroserviceApp(TopologyParams(
+        num_services=num_services, num_endpoints=num_endpoints, seed=seed))
+    after = SyntheticMicroserviceApp(TopologyParams(
+        num_services=num_services_after, num_endpoints=num_endpoints,
+        seed=seed))
+    scenario.generic_endpoints = len(before.endpoints)
+    return before, after, before.endpoints
+
+
+def simulate_drift_corpus_iter(
+    scenario: LoadScenario,
+    num_buckets: int,
+    shift_at: int,
+    app_before,
+    app_after,
+    endpoints: tuple[str, ...],
+    anomalies: list[Anomaly] | None = None,
+    resource_seed: int | None = None,
+):
+    """Constant-memory corpus with a MID-CORPUS topology change: buckets
+    before ``shift_at`` generate traces from ``app_before``, buckets at
+    and after it from ``app_after`` (the rolling-deployment scenario the
+    synthetic ``--services`` generator owed ROADMAP item 6).
+
+    The fixed metric keyset is the UNION of both topologies' declared
+    component sets, so every bucket carries identical keys — removed
+    services go idle (their resource series fall to base load), added
+    services come alive at the shift, exactly like a real scrape across
+    a deployment.  Combine with an ``Anomaly`` whose window starts after
+    ``shift_at`` for the ransomware-mid-drift scenario (the anomaly
+    component must exist in ``app_after``)."""
+    if not (0 < shift_at <= num_buckets):
+        raise ValueError(
+            f"shift_at {shift_at} must be in (0, num_buckets"
+            f"={num_buckets}]")
+    for app in (app_before, app_after):
+        if not getattr(app, "components", ()):
+            raise TypeError(
+                "drift corpora need apps that declare .components "
+                "(synthetic topologies do) — the union keyset cannot be "
+                "discovered from a prefix that predates the shift")
+    traffic = scenario.traffic(num_buckets)
+    if traffic.shape[1] != len(endpoints):
+        raise ValueError(
+            f"scenario emits {traffic.shape[1]}-endpoint traffic but the "
+            f"app has {len(endpoints)} endpoints — set "
+            "scenario.generic_endpoints")
+    ordered = sorted(set(app_before.components) | set(app_after.components))
+    comp_set = set(ordered)
+    trace_rng = np.random.default_rng(scenario.seed + 3)
+    model = ResourceModel(
+        seed=scenario.seed if resource_seed is None else resource_seed,
+        anomalies=anomalies,
+    )
+    for t in range(num_buckets):
+        app = app_before if t < shift_at else app_after
+        traces = []
+        for api_idx, api in enumerate(endpoints):
+            for _ in range(int(traffic[t, api_idx])):
+                traces.extend(app.generate(api, trace_rng))
+        ops, writes = count_ops(traces)
+        unknown = set(ops) - comp_set
+        if unknown:
+            raise ValueError(
+                f"bucket {t}: components {sorted(unknown)} outside the "
+                "declared union keyset — both apps must declare "
+                ".components")
+        yield Bucket(
+            metrics=model.step_counts(ops, writes, components=ordered),
+            traces=traces)
+
+
 def write_corpus_jsonl(scenario: LoadScenario, num_buckets: int,
                        out_path: str, app=None, endpoints=None,
                        anomalies=None) -> dict:
@@ -291,11 +380,54 @@ def main(argv: list[str] | None = None) -> None:
                     help="synthetic app: number of services")
     ap.add_argument("--endpoints", type=int, default=12,
                     help="synthetic app: number of API endpoints")
+    ap.add_argument("--shift-at", type=int, default=0,
+                    help="mid-corpus topology change: buckets at/after "
+                         "this index generate from a re-drawn synthetic "
+                         "topology with --services-after services "
+                         "(0 = no shift; synthetic app only)")
+    ap.add_argument("--services-after", type=int, default=None,
+                    help="service count of the post-shift topology "
+                         "(default: --services + 50%%)")
     args = ap.parse_args(argv)
 
     scenario = SCENARIOS[args.scenario](args.seed)
     scenario.calls_per_user = args.calls_per_user
     app = endpoints = None
+    if args.shift_at:
+        if args.app != "synthetic":
+            ap.error("--shift-at needs --app synthetic (the social "
+                     "topology is fixed)")
+        after_n = (args.services_after if args.services_after is not None
+                   else args.services + max(args.services // 2, 1))
+        before, after, endpoints = build_shifted_app(
+            scenario, args.services, after_n, args.endpoints, args.seed)
+        it = simulate_drift_corpus_iter(
+            scenario, args.buckets, args.shift_at, before, after,
+            endpoints, anomalies=args.anomaly)
+        if args.out.endswith(".pkl"):
+            buckets = list(it)
+            save_raw_data_pickle(buckets, args.out)
+            stats = {"buckets": len(buckets),
+                     "traces": sum(len(b.traces) for b in buckets),
+                     "metric_keys": len(buckets[0].metrics)}
+        else:
+            from deeprest_tpu.data.schema import save_raw_data_jsonl
+
+            stats = {"buckets": 0, "traces": 0, "metric_keys": 0}
+
+            def counted():
+                for b in it:
+                    stats["buckets"] += 1
+                    stats["traces"] += len(b.traces)
+                    stats["metric_keys"] = len(b.metrics)
+                    yield b
+
+            save_raw_data_jsonl(counted(), args.out)
+        print(f"wrote {stats['buckets']} buckets ({args.services}->"
+              f"{after_n} services at bucket {args.shift_at}), "
+              f"{stats['traces']} traces, {stats['metric_keys']} metric "
+              f"keys -> {args.out}")
+        return
     if args.app == "synthetic":
         app, endpoints = build_synthetic_app(scenario, args.services,
                                              args.endpoints, args.seed)

@@ -855,6 +855,42 @@ def test_dn001_non_watchlist_modules_are_silent():
     assert not findings_for("DN001", DN001_BAD, rel="serve/fused.py")
 
 
+# round 18: ALL of obs/ is watched — the quality monitors touch the
+# F-wide feature space per sweep; their contract is COO rows in with the
+# one dense window built through ops/densify.py, never a local F-wide
+# np.zeros
+DN001_OBS_BAD = """
+import numpy as np
+
+class Monitor:
+    def sweep(self, rows):
+        window = np.zeros((len(rows), self.capacity), np.float32)
+        return window
+"""
+DN001_OBS_GOOD = """
+import numpy as np
+from deeprest_tpu.ops.densify import densify_rows
+
+class Monitor:
+    def sweep(self, cols, vals):
+        kmax = max(len(c) for c in cols)
+        pad_c = np.zeros((len(cols), kmax), np.int32)
+        return densify_rows(pad_c, vals, self.capacity)
+"""
+
+
+def test_dn001_obs_directory_pair():
+    # any file under obs/ is hot (the quality monitors live there); the
+    # sanctioned path pads COO rows (K-wide, not F-wide) and densifies
+    # through ops/densify.py
+    assert_pair("DN001", DN001_OBS_BAD, DN001_OBS_GOOD,
+                rel="obs/quality.py")
+    assert_pair("DN001", DN001_OBS_BAD, DN001_OBS_GOOD,
+                rel="deeprest_tpu/obs/metrics.py")
+    # ops/ itself stays out of scope — it IS the densification home
+    assert not findings_for("DN001", DN001_OBS_BAD, rel="ops/densify.py")
+
+
 def test_hy001_unused_import_pair():
     bad = "import os\nimport sys\n\nprint(sys.argv)\n"
     good = "import sys\n\nprint(sys.argv)\n"

@@ -1,0 +1,90 @@
+"""drift_bench plumbing gate (tier-1): the --quick arms run end-to-end,
+their gates hold, and the committed full-mode artifact keeps asserting
+the real budget + detection claims.
+
+The quick mode keeps tier-1 honest about PLUMBING (the corpus generator,
+the stream+controller loop, the verdict events, the A/B overhead
+harness) with a relaxed timing budget; the committed
+benchmarks/drift_bench.json is the full-mode record whose gates this
+file re-checks without re-running the bench.  The quick bench runs ONCE
+per module (session fixture) — its record and headline line feed every
+test below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED = os.path.join(REPO, "benchmarks", "drift_bench.json")
+
+
+@pytest.fixture(scope="module")
+def quick_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("drift_bench") / "drift_bench.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "drift_bench.py"),
+         "--quick", "--headline", "--out", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return json.loads(out.read_text()), proc.stdout
+
+
+def test_drift_bench_quick_gates(quick_run):
+    rec, _ = quick_run
+    assert rec["mode"] == "quick"
+
+    det = rec["detection"]
+    assert det["ok"]
+    assert det["false_flags_before_shift"] == 0
+    assert det["detection_sweeps"] is not None
+    assert det["detection_sweeps"] <= det["budget_sweeps"]
+    assert det["retrains_triggered"] >= 1
+    assert det["drift_exited_at"] is not None
+
+    rw = rec["ransomware_mid_drift"]
+    assert rw["ok"]
+    assert rw["anomaly_flagged_at"] is not None
+    assert rw["anomaly_flagged_at"] >= rw["anomaly_start"]
+    assert rw["anomaly_metrics"], rw
+    assert all(m.startswith(rw["store"]) for m in rw["anomaly_metrics"])
+
+    clean = rec["clean"]
+    assert clean["ok"]
+    assert clean["verdict_events"] == []
+    assert clean["retrains_triggered"] == 0
+
+    ov = rec["overhead"]
+    assert ov is not None
+    assert ov["overhead_pct"] <= ov["budget_pct"]
+
+
+def test_headline_emits_schema_v10_keys(quick_run):
+    """bench.py (schema v10) consumes exactly these keys."""
+    _, stdout = quick_run
+    line = stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert "drift_detection_sweeps" in rec
+    assert "drift_overhead_pct" in rec
+    assert rec["drift_detection_sweeps"] is not None
+
+
+def test_committed_record_keeps_the_budget():
+    """The committed full-mode dossier: every arm green, the detection
+    latency inside its budget, and monitor overhead inside the round-14
+    ≤3% budget."""
+    with open(COMMITTED, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rec["mode"] == "full"
+    assert rec["detection"]["ok"]
+    assert rec["detection"]["detection_sweeps"] \
+        <= rec["detection"]["budget_sweeps"]
+    assert rec["ransomware_mid_drift"]["ok"]
+    assert rec["clean"]["ok"]
+    assert rec["overhead"]["overhead_pct"] <= 3.0
