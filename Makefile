@@ -6,6 +6,15 @@
 #   make lint-changed     graftlint scoped to files changed vs git HEAD
 #                         (whole project still parsed for the call
 #                         graph), SARIF output for CI inline annotation
+#   make lint-fix         apply the safe mechanical fixes (HY001 unused
+#                         imports, HY002 unreachable code); loops until
+#                         stable, refuses suppressed findings, second
+#                         run is a byte-identical no-op
+#   make lint-sarif       full-repo SARIF 2.1.0 artifact (lint.sarif) —
+#                         the artifact deploy/ci/lint-gate.sh uploads
+#   make lint-gate        the committed pre-merge gate: lint --changed
+#                         (SARIF) + the tier-1 test command
+#                         (deploy/ci/lint-gate.sh)
 #   make native           build the C++ featurizer (native/Makefile)
 #   make tsan             build the thread-sanitized featurizer selftest
 #                         — the native-side twin of the TH rule pack
@@ -50,6 +59,16 @@ lint:
 lint-changed:
 	$(PYTHON) -m deeprest_tpu lint --changed --format sarif
 
+lint-fix:
+	$(PYTHON) -m deeprest_tpu lint --fix
+
+lint-sarif:
+	$(PYTHON) -m deeprest_tpu lint --format sarif > lint.sarif; \
+	status=$$?; echo "wrote lint.sarif"; exit $$status
+
+lint-gate:
+	bash deploy/ci/lint-gate.sh
+
 native:
 	$(MAKE) -C native
 
@@ -74,5 +93,6 @@ chaos-bench:
 drift-bench:
 	$(PYTHON) benchmarks/drift_bench.py --out benchmarks/drift_bench.json
 
-.PHONY: lint lint-changed native tsan bench-multichip \
-	serve-bench-replicas obs-bench tenk-bench chaos-bench drift-bench
+.PHONY: lint lint-changed lint-fix lint-sarif lint-gate native tsan \
+	bench-multichip serve-bench-replicas obs-bench tenk-bench \
+	chaos-bench drift-bench

@@ -7,34 +7,43 @@ deeprest_tpu.analysis``, or programmatically::
     result = lint_paths(["deeprest_tpu"])
     assert not result.findings
 
-Rule packs: JX (JAX compile/readback/donation invariants — rules_jax),
-TH (threading — rules_threading), HY (hygiene — rules_hygiene), OB
-(observability — rules_obs), DN (sparse-first data plane — rules_data),
-RS (resource lifecycle — rules_lifecycle), EX (exception safety —
-rules_exceptions), GL (framework meta-rules — core).  The whole-program
-symbol table / call graph and the path-sensitive paired-operation
-walker live in core (CallGraph, ObligationWalker).  ANALYSIS.md is the
-human catalog.
+Rule packs: JX (JAX compile/readback/donation/dtype invariants —
+rules_jax), TH (threading — rules_threading), HY (hygiene —
+rules_hygiene), OB (observability — rules_obs), DN (sparse-first data
+plane — rules_data), RS (resource lifecycle — rules_lifecycle), EX
+(exception safety — rules_exceptions), GL (framework meta-rules —
+core).  The whole-program symbol table / call graph and the
+path-sensitive paired-operation walker live in core (CallGraph,
+ObligationWalker); the interprocedural value-flow engine (dtype x
+denseness x host/device lattice, bounded summaries — behind
+DN001/DN002/JX006/JX007) lives in dataflow (ValueFlow).  The
+incremental cache is cache (lint_paths_cached), the HY001/HY002
+autofixer is autofix (fix_paths).  ANALYSIS.md is the human catalog.
 """
 
 from deeprest_tpu.analysis.core import (
     CallGraph, Finding, FuncKey, LintResult, ObligationWalker, Project,
-    Rule, SuppressionEntry, all_rules, default_baseline_path, lint_paths,
-    lint_project, lint_sources, load_baseline, load_project,
-    save_baseline, suppression_inventory, transitive_closure,
+    Rule, SuppressionEntry, all_rules, analyze_project, apply_baseline,
+    default_baseline_path, lint_paths, lint_project, lint_sources,
+    load_baseline, load_project, save_baseline, suppression_inventory,
+    transitive_closure,
 )
+from deeprest_tpu.analysis.dataflow import AbsVal, ValueFlow
+from deeprest_tpu.analysis.cache import LintCache, lint_paths_cached
+from deeprest_tpu.analysis.autofix import FixReport, fix_paths
 from deeprest_tpu.analysis.reporters import (
     render_json, render_rules, render_sarif, render_suppressions_json,
     render_suppressions_markdown, render_suppressions_text, render_text,
 )
 
 __all__ = [
-    "CallGraph", "Finding", "FuncKey", "LintResult", "ObligationWalker",
-    "Project", "Rule", "SuppressionEntry", "all_rules",
-    "default_baseline_path", "lint_paths", "lint_project", "lint_sources",
-    "load_baseline", "load_project", "save_baseline",
-    "suppression_inventory", "transitive_closure", "render_json",
-    "render_rules", "render_sarif", "render_suppressions_json",
-    "render_suppressions_markdown", "render_suppressions_text",
-    "render_text",
+    "AbsVal", "CallGraph", "Finding", "FixReport", "FuncKey",
+    "LintCache", "LintResult", "ObligationWalker", "Project", "Rule",
+    "SuppressionEntry", "ValueFlow", "all_rules", "analyze_project",
+    "apply_baseline", "default_baseline_path", "fix_paths", "lint_paths",
+    "lint_paths_cached", "lint_project", "lint_sources", "load_baseline",
+    "load_project", "save_baseline", "suppression_inventory",
+    "transitive_closure", "render_json", "render_rules", "render_sarif",
+    "render_suppressions_json", "render_suppressions_markdown",
+    "render_suppressions_text", "render_text",
 ]

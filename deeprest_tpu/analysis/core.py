@@ -160,22 +160,29 @@ class Project:
 
     @classmethod
     def from_dir(cls, root: str, jobs: int | None = None) -> "Project":
-        paths = []
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = sorted(d for d in dirnames
-                                 if d not in ("__pycache__", ".git"))
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                full = os.path.join(dirpath, name)
-                rel = os.path.relpath(full, root).replace(os.sep, "/")
-                paths.append((rel, full))
-        return cls(parse_files(paths, jobs=jobs))
+        return cls(parse_files(walk_py_files(root), jobs=jobs))
 
     @classmethod
     def from_sources(cls, sources: dict[str, str]) -> "Project":
         """Tests and callers with in-memory code: {relpath: source}."""
         return cls([SourceFile(rel, src) for rel, src in sources.items()])
+
+
+def walk_py_files(root: str) -> list[tuple[str, str]]:
+    """``(rel, full_path)`` for every .py under ``root``, sorted — the
+    one directory walk Project.from_dir and the lint cache share, so
+    both layers agree on file identity."""
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            paths.append((rel, full))
+    return paths
 
 
 def _parse_one(item: tuple[str, str]) -> SourceFile:
@@ -264,8 +271,36 @@ def all_rules() -> dict[str, Rule]:
 # -- meta rules (the linter checking its own machinery) ---------------------
 
 
-def _meta_findings(project: Project, known_rules: set[str]) -> list[Finding]:
+def _meta_findings(project: Project, known_rules: set[str],
+                   rule_objs: Iterable[Rule] = ()) -> list[Finding]:
     out = []
+    # GL004: an uncited rule.  Every registered rule must carry the
+    # guarded-incident citation (`guards`) that --list-rules and the
+    # ANALYSIS.md catalog surface — a rule that cannot say which
+    # incident it prevents is a rule nobody can review, suppress
+    # against, or retire.  Anchored at the rule's class definition when
+    # the pack file is inside the linted tree.
+    for rule in rule_objs:
+        if rule.guards and rule.title:
+            continue
+        cls_name = type(rule).__name__
+        path, line = "<registry>", 0
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            hit = next((n for n in sf.tree.body
+                        if isinstance(n, ast.ClassDef)
+                        and n.name == cls_name), None)
+            if hit is not None:
+                path, line = sf.rel, hit.lineno
+                break
+        missing = ("guarded-incident citation (guards)" if rule.title
+                   else "title and guarded-incident citation")
+        out.append(Finding(
+            path, line, 0, "GL004",
+            f"rule {rule.id} ({cls_name}) is registered without a "
+            f"{missing}: every rule must name the incident it guards "
+            "against (--list-rules / ANALYSIS.md catalog)"))
     for f in project.files:
         if f.syntax_error is not None:
             out.append(Finding(f.rel, f.syntax_error.lineno or 1, 0, "GL003",
@@ -288,6 +323,7 @@ GL_RULES = {
     "GL001": "suppression missing its required reason string",
     "GL002": "suppression names a rule id that does not exist",
     "GL003": "file does not parse",
+    "GL004": "registered rule lacks its guarded-incident citation",
 }
 
 
@@ -329,13 +365,18 @@ class LintResult:
     files: int
 
 
-def lint_project(project: Project,
-                 rules: Iterable[Rule] | None = None,
-                 baseline_keys: Iterable[str] | None = None) -> LintResult:
+def analyze_project(project: Project,
+                    rules: Iterable[Rule] | None = None,
+                    ) -> tuple[list[Finding], int]:
+    """Run meta checks + rule packs and apply in-code suppressions:
+    ``(kept findings, suppressed count)``.  This is the (expensive,
+    content-determined) half the incremental cache stores — the
+    baseline split happens in :func:`apply_baseline` because the
+    baseline file can change independently of the tree."""
     rule_objs = (list(rules) if rules is not None
                  else list(all_rules().values()))
     raw: list[Finding] = _meta_findings(
-        project, {r.id for r in rule_objs} | set(all_rules()))
+        project, {r.id for r in rule_objs} | set(all_rules()), rule_objs)
     for rule in rule_objs:
         raw.extend(rule.run(project))
 
@@ -347,7 +388,11 @@ def lint_project(project: Project,
             suppressed += 1
         else:
             kept.append(f)
+    return kept, suppressed
 
+
+def apply_baseline(kept: Iterable[Finding], suppressed: int, files: int,
+                   baseline_keys: Iterable[str] | None) -> LintResult:
     # Baseline keys consume one finding each (a multiset match): two
     # identical findings with one baseline entry leave one live.
     budget: dict[str, int] = {}
@@ -362,22 +407,35 @@ def lint_project(project: Project,
         else:
             live.append(f)
     return LintResult(findings=live, baselined=base,
-                      suppressed_count=suppressed, files=len(project.files))
+                      suppressed_count=suppressed, files=files)
+
+
+def lint_project(project: Project,
+                 rules: Iterable[Rule] | None = None,
+                 baseline_keys: Iterable[str] | None = None) -> LintResult:
+    kept, suppressed = analyze_project(project, rules=rules)
+    return apply_baseline(kept, suppressed, len(project.files),
+                          baseline_keys)
+
+
+def collect_py_files(paths: Iterable[str]) -> list[tuple[str, str]]:
+    """``(rel, full_path)`` pairs for directories and/or single files —
+    the shared file selector behind :func:`load_project` and the
+    incremental cache's content-hash manifest."""
+    out: list[tuple[str, str]] = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(walk_py_files(path))
+        else:
+            out.append((os.path.basename(path), path))
+    return out
 
 
 def load_project(paths: Iterable[str],
                  jobs: int | None = None) -> Project:
     """One Project over directories and/or single files (the CLI's
     loading path; ``jobs`` fans the parse across worker processes)."""
-    files: list[SourceFile] = []
-    for path in paths:
-        if os.path.isdir(path):
-            files.extend(Project.from_dir(path, jobs=jobs).files)
-        else:
-            rel = os.path.basename(path)
-            with open(path, encoding="utf-8") as f:
-                files.append(SourceFile(rel, f.read()))
-    return Project(files)
+    return Project(parse_files(collect_py_files(paths), jobs=jobs))
 
 
 def lint_paths(paths: Iterable[str],
@@ -611,6 +669,9 @@ class CallGraph:
         # rel → {alias → ("mod", parts) | ("obj", parts, name)}
         self._imports: dict[str, dict[str, tuple]] = {}
         self._edges: dict[FuncKey, set[FuncKey]] = {}
+        # reachable()'s string-keyed view, built once on first use
+        self._str_edges: dict[str, set[str]] | None = None
+        self._by_str: dict[str, FuncKey] = {}
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -770,11 +831,14 @@ class CallGraph:
                   max_depth: int | None = None) -> set[FuncKey]:
         """Bounded-depth transitive closure over the resolved graph."""
         depth = self.MAX_DEPTH if max_depth is None else max_depth
-        edges = {str(k): {str(v) for v in vs}
-                 for k, vs in self._edges.items()}
-        by_str = {str(k): k for k in self._edges}
-        names = transitive_closure(edges, [str(s) for s in seeds], depth)
-        return {by_str[n] for n in names if n in by_str}
+        if self._str_edges is None:
+            # built once: several rules call reachable() per lint run
+            self._str_edges = {str(k): {str(v) for v in vs}
+                               for k, vs in self._edges.items()}
+            self._by_str = {str(k): k for k in self._edges}
+        names = transitive_closure(self._str_edges,
+                                   [str(s) for s in seeds], depth)
+        return {self._by_str[n] for n in names if n in self._by_str}
 
     def class_method_edges(self, rel: str,
                            cls: str) -> dict[str, set[str]]:

@@ -7,16 +7,36 @@ padded-COO rings, and densification happens ONCE, on device, inside the
 existing executables (ops/densify.py).  DN001 keeps the hot ingest/refresh
 modules from quietly re-growing ``[..., F]``-wide dense traffic
 allocations after that migration.
+
+Since round 19 both rules ride the graftflow value-flow engine
+(analysis/dataflow.py): DN001 is a pure filter over the engine's
+syntactic allocation-site table (verdicts pinned bit-for-bit against the
+pre-migration rule by tests/test_analysis.py), and DN002 is the
+interprocedural generalization — a dense F-trailing HOST allocation
+anywhere in the repo whose *value* reaches the sparse-first hot zones
+(train/stream, serve/, obs/) through any call chain, attribute store, or
+tuple unpacking fires at the ORIGIN allocation, not the sink.
 """
 
 from __future__ import annotations
 
-import ast
 from typing import Iterator
 
-from deeprest_tpu.analysis.core import (
-    Finding, Project, Rule, call_name, register,
-)
+from deeprest_tpu.analysis.core import Finding, Project, Rule, register
+from deeprest_tpu.analysis.dataflow import ValueFlow, in_zone
+
+
+def _dn001_watch(rel: str) -> bool:
+    """The DN001 watchlist: the two modules the sparse-first migration
+    converted, plus ALL of obs/ (round 18).  Component-wise suffix match
+    (the JX003 lesson: bare-name lists silently exempt moved files)."""
+    parts = tuple(rel.replace("\\", "/").split("/"))
+    if any(d in parts[:-1] for d in DN001DenseTrafficMaterialization
+           .WATCH_DIRS):
+        return True
+    return any(parts[-2:] == w or parts[-len(w):] == w
+               for w in DN001DenseTrafficMaterialization.WATCH
+               if len(parts) >= len(w))
 
 
 @register
@@ -43,57 +63,72 @@ class DN001DenseTrafficMaterialization(Rule):
     # feature space on every sweep — their contract is COO rows in with
     # the one dense window built through ops/densify.py, so a dense
     # per-sweep allocation here is exactly the regression DN001 exists
-    # to catch).  Component-wise suffix match (the JX003 lesson:
-    # bare-name lists silently exempt moved files).
+    # to catch).
     WATCH = (("train", "stream.py"), ("data", "featurize.py"))
-    # Directory components watched wholesale (any file under them).
     WATCH_DIRS = ("obs",)
 
-    _ALLOCS = {"np.zeros", "np.empty", "np.ones", "np.full",
-               "numpy.zeros", "numpy.empty", "numpy.ones", "numpy.full"}
-    # Identifier fragments that mark a traffic-width dimension.  Matched
-    # against the LAST element of a literal shape tuple only — leading
-    # (time/batch) axes are fine, it is the trailing F that explodes.
-    _WIDTH_MARKERS = ("capacity", "feature_dim", "num_features")
+    def run(self, project: Project) -> Iterator[Finding]:
+        flow = ValueFlow.of(project)
+        for site in flow.alloc_sites.values():
+            if not (site.host and site.literal_tuple
+                    and site.trailing_marker
+                    and _dn001_watch(site.rel)):
+                continue
+            sf = project.by_rel.get(site.rel)
+            if sf is None:
+                continue
+            yield sf.finding(
+                site.node, self.id,
+                "dense traffic allocation with a capacity-wide "
+                "trailing dimension in a sparse-first hot module: "
+                "carry (cols, vals) padded-COO rows and let "
+                "ops/densify.py scatter on device (suppress with "
+                "a reason only for the pinned dense reference "
+                "paths)")
 
-    def _is_hot(self, rel: str) -> bool:
-        parts = tuple(rel.replace("\\", "/").split("/"))
-        if any(d in parts[:-1] for d in self.WATCH_DIRS):
-            return True
-        return any(parts[-2:] == w or parts[-len(w):] == w
-                   for w in self.WATCH if len(parts) >= len(w))
 
-    @classmethod
-    def _is_width_expr(cls, node: ast.AST) -> bool:
-        for sub in ast.walk(node):
-            name = None
-            if isinstance(sub, ast.Name):
-                name = sub.id
-            elif isinstance(sub, ast.Attribute):
-                name = sub.attr
-            if name is not None and any(m in name.lower()
-                                        for m in cls._WIDTH_MARKERS):
-                return True
-        return False
+@register
+class DN002InterproceduralDenseTaint(Rule):
+    id = "DN002"
+    title = ("dense F-trailing host allocation whose value reaches a "
+             "sparse-first hot zone (train/stream, serve/, obs/) through "
+             "the call graph — fires at the origin allocation")
+    guards = ("round 19: DN001 only sees allocations INSIDE its "
+              "watchlist, so a dense [.., F] buffer built in a helper "
+              "module and handed to the stream/serving/obs planes "
+              "through a call chain (the exact shape the fleet tier's "
+              "per-app axes and the push-ingest firehose are about to "
+              "multiply — ROADMAP items 3-4, where one dense F-wide "
+              "alloc at F=10240 re-inflates the 80x byte win) landed "
+              "unseen.  graftflow propagates denseness taint through "
+              "returns, call args, attribute stores, and tuple "
+              "unpacking, and this rule fires at the ORIGIN allocation "
+              "of any tainted value that reaches the zones")
 
     def run(self, project: Project) -> Iterator[Finding]:
-        for sf in project.files:
-            if sf.tree is None or not self._is_hot(sf.rel):
+        flow = ValueFlow.of(project)
+        for origin in sorted(flow.zone_hits):
+            site = flow.alloc_sites.get(origin)
+            if site is None or not site.host:
                 continue
-            for node in ast.walk(sf.tree):
-                if not (isinstance(node, ast.Call)
-                        and call_name(node.func) in self._ALLOCS
-                        and node.args):
-                    continue
-                shape = node.args[0]
-                if not (isinstance(shape, ast.Tuple) and shape.elts):
-                    continue
-                if self._is_width_expr(shape.elts[-1]):
-                    yield sf.finding(
-                        node, self.id,
-                        "dense traffic allocation with a capacity-wide "
-                        "trailing dimension in a sparse-first hot module: "
-                        "carry (cols, vals) padded-COO rows and let "
-                        "ops/densify.py scatter on device (suppress with "
-                        "a reason only for the pinned dense reference "
-                        "paths)")
+            # DN001's beat: a marker-shaped allocation inside its own
+            # watchlist already fires (or carries a reasoned
+            # suppression) there — one owner per site
+            if (site.literal_tuple and site.trailing_marker
+                    and _dn001_watch(site.rel)):
+                continue
+            sf = project.by_rel.get(site.rel)
+            if sf is None:
+                continue
+            sink = flow.zone_hits[origin]
+            where = ("this sparse-first hot zone" if in_zone(site.rel)
+                     else f"the sparse-first hot zone ({sink})")
+            yield sf.finding(
+                site.node, self.id,
+                "dense F-trailing host allocation reaches "
+                f"{where} through the call graph: the hot zones "
+                "(train/stream, serve/, obs/) carry padded-COO "
+                "(cols, vals) rows and densify ONCE on device "
+                "(ops/densify.py); keep the dense buffer out of the "
+                "zone or suppress here with a reason if this is a "
+                "pinned dense reference path")

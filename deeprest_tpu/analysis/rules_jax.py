@@ -511,3 +511,205 @@ class JX004UseAfterDonation(Rule):
 
         visit_block(fn.body)
         yield from findings
+
+
+# -- graftflow-powered rules (round 19) -------------------------------------
+#
+# JX006/JX007 consume the value-flow engine (analysis/dataflow.py): the
+# same facts DN002 uses — dtype lattice, host/device domain, and the
+# call-graph reachability that lets a rule range beyond a syntactic
+# per-file watchlist without drowning in false positives.
+
+
+def _jit_scope_nodes(project: Project) -> dict[str, set[int]]:
+    """``{rel: {id(fn_node), ...}}`` of every function body that is
+    jit-traced: functions handed to jax.jit/pjit in each file, plus
+    every project function reachable from one through the call graph
+    (tracing inlines callees, so their bodies compile too)."""
+    graph = project.call_graph()
+    node_to_key = {id(n): k for k, n in graph.functions.items()}
+    scopes: dict[str, set[int]] = {}
+    seeds = []
+    for sf in project.files:
+        ids = scopes.setdefault(sf.rel, set())
+        for fn, _site in _jitted_functions(sf):
+            ids.add(id(fn))
+            key = node_to_key.get(id(fn))
+            if key is not None:
+                seeds.append(key)
+                continue
+            # nested jitted defs/lambdas are not call-graph nodes; seed
+            # the closure from the calls their bodies resolve instead
+            cls = next((a.name for a in sf.ancestors(fn)
+                        if isinstance(a, ast.ClassDef)), None)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    hit = graph.resolve_call(sf.rel, cls, "", sub)
+                    if hit is not None:
+                        seeds.append(hit)
+    for key in project.call_graph().reachable(seeds):
+        node = graph.function_node(key)
+        if node is not None:
+            scopes.setdefault(key.rel, set()).add(id(node))
+    return scopes
+
+
+def _in_scope(sf: SourceFile, node: ast.AST,
+              scope_ids: set[int]) -> bool:
+    if id(node) in scope_ids:
+        return True
+    return any(id(a) in scope_ids for a in sf.ancestors(node))
+
+
+@register
+class JX006DtypePromotionInJit(Rule):
+    id = "JX006"
+    title = ("dtype-promotion hazard inside jit-traced code: an np.* "
+             "f64-defaulting host constant, an explicit float64 "
+             "widening, or an int-array x python-float promotion")
+    guards = ("PR 4's pin_state drift was a compile-time constant whose "
+              "rounding differed from the runtime kernels; np/jnp "
+              "mixing inside traced code is the same class — np.zeros "
+              "defaults to float64 (silently upcasting the f32/bf16 "
+              "plane under x64, or re-rounding through f64 otherwise), "
+              "and call-path counts are natively integers, so a bare "
+              "python-float constant op silently floats them.  "
+              "graftflow proves which functions the jit trace actually "
+              "reaches (call-graph closure over the jitted seeds), so "
+              "the rule ranges over helpers the syntactic packs cannot "
+              "see")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        from deeprest_tpu.analysis.dataflow import ValueFlow
+
+        flow = ValueFlow.of(project)
+        scopes = _jit_scope_nodes(project)
+        seen: set[tuple] = set()
+
+        def emit(rel: str, node: ast.AST, message: str):
+            sf = project.by_rel.get(rel)
+            if sf is None:
+                return None
+            dk = (rel, getattr(node, "lineno", 0),
+                  getattr(node, "col_offset", 0), message[:40])
+            if dk in seen:
+                return None
+            seen.add(dk)
+            return sf.finding(node, self.id, message)
+
+        for c in flow.np_calls:
+            ids = scopes.get(c.rel)
+            if not ids or c.has_dtype:
+                continue
+            sf = project.by_rel[c.rel]
+            if not _in_scope(sf, c.node, ids):
+                continue
+            f = emit(c.rel, c.node,
+                     f"{c.dotted}(...) without an explicit dtype inside "
+                     "jit-traced code bakes a float64-defaulting host "
+                     "constant into the trace: it silently upcasts the "
+                     "f32/bf16 plane (or re-rounds through f64); use "
+                     "jnp here, or pass an explicit dtype")
+            if f is not None:
+                yield f
+        for cast in flow.f64_casts:
+            ids = scopes.get(cast.rel)
+            if not ids:
+                continue
+            sf = project.by_rel[cast.rel]
+            if not _in_scope(sf, cast.node, ids):
+                continue
+            f = emit(cast.rel, cast.node,
+                     f"explicit float64 widening ({cast.why}) inside "
+                     "jit-traced code: the plane computes in f32/bf16 "
+                     "with a pinned parity envelope — an f64 subgraph "
+                     "re-rounds everything it touches")
+            if f is not None:
+                yield f
+        for p in flow.promotions:
+            ids = scopes.get(p.rel)
+            if not ids:
+                continue
+            sf = project.by_rel[p.rel]
+            if not _in_scope(sf, p.node, ids):
+                continue
+            if "f64" in (p.left, p.right):
+                msg = (f"{p.left} x {p.right} promotion inside "
+                       "jit-traced code: the float64 side infects the "
+                       "whole expression (np default-dtype leak — keep "
+                       "traced math in jnp/f32)")
+            else:
+                msg = ("integer array x python-float promotion inside "
+                       "jit-traced code: call-path counts are natively "
+                       "integers — a bare float constant silently "
+                       "floats them; make the cast explicit "
+                       "(.astype/jnp.float32) so the rounding is "
+                       "deliberate")
+            f = emit(p.rel, p.node, msg)
+            if f is not None:
+                yield f
+
+
+@register
+class JX007TransitiveHostDeviceCrossing(Rule):
+    id = "JX007"
+    title = ("host/device domain crossing (.item()/float()/np.asarray) "
+             "in a loop, in code reached transitively from the trainer/"
+             "fused/batcher entry points, on a value graftflow proves "
+             "is a device array")
+    guards = ("PRs 2-4 hand-hunted per-iteration device→host syncs; "
+              "JX003 guards them syntactically but only inside its "
+              "directory watchlist (ops/, serve/, train/trainer.py).  "
+              "The coalesced recurrence paths and checkpoint/stream "
+              "helpers sit OUTSIDE that list yet run inside the hot "
+              "loops — JX007 replaces the per-file heuristic with "
+              "call-graph reachability from the trainer/fused/batcher "
+              "entry points and fires only when the engine PROVES the "
+              "converted value lives on device, so host-side numpy "
+              "plumbing stays silent without a watchlist exemption")
+
+    # entry points of the hot planes; reachability (not directory
+    # membership) decides what is hot
+    ENTRY_SUFFIXES = (("train", "trainer.py"), ("serve", "fused.py"),
+                      ("serve", "batcher.py"))
+
+    @classmethod
+    def _is_entry_rel(cls, rel: str) -> bool:
+        parts = tuple(rel.replace("\\", "/").split("/"))
+        return any(parts[-len(s):] == s for s in cls.ENTRY_SUFFIXES
+                   if len(parts) >= len(s))
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        from deeprest_tpu.analysis.dataflow import ValueFlow
+
+        flow = ValueFlow.of(project)
+        graph = project.call_graph()
+        seeds = [k for k in graph.functions if self._is_entry_rel(k.rel)]
+        if not seeds:
+            return
+        reach = graph.reachable(seeds)
+        jx003 = JX003ReadbackInHotLoop()
+        seen: set[tuple[str, int, int]] = set()
+        for c in flow.crossings:
+            if c.key is None or c.key not in reach:
+                continue
+            if c.arg_domain != "device":
+                continue                 # only PROVEN device values fire
+            if jx003._is_hot(c.rel):
+                continue                 # JX003's syntactic beat
+            sf = project.by_rel.get(c.rel)
+            if sf is None or not in_loop(sf, c.node):
+                continue
+            dk = (c.rel, getattr(c.node, "lineno", 0),
+                  getattr(c.node, "col_offset", 0))
+            if dk in seen:
+                continue
+            seen.add(dk)
+            yield sf.finding(
+                c.node, self.id,
+                f"{c.kind} on a device array inside a loop, in code "
+                f"reached from the {'/'.join(p[-1] for p in self.ENTRY_SUFFIXES)} "
+                "hot entry points: each iteration is a device→host "
+                "sync stalling the pipeline; accumulate on device and "
+                "read back once after the loop (or suppress with a "
+                "reason if this is the designed sink)")

@@ -1126,6 +1126,12 @@ def cmd_lint(args) -> int:
         print(f"lint: no such path {missing}")
         return 2
 
+    if args.fix:
+        from deeprest_tpu.analysis.autofix import fix_paths
+
+        report = fix_paths(paths)
+        print(report.summary())
+        return 0
     if args.list_suppressions:
         entries = suppression_inventory(load_project(paths, jobs=jobs))
         if args.format == "json":
@@ -1150,8 +1156,15 @@ def cmd_lint(args) -> int:
     except ValueError as exc:
         print(f"lint: {exc}")
         return 2
-    result = lint_paths(paths, rules=rules, baseline_keys=baseline_keys,
-                        jobs=jobs)
+    if args.no_cache:
+        result = lint_paths(paths, rules=rules,
+                            baseline_keys=baseline_keys, jobs=jobs)
+    else:
+        from deeprest_tpu.analysis.cache import lint_paths_cached
+
+        result, _cache = lint_paths_cached(
+            paths, rules=rules, baseline_keys=baseline_keys, jobs=jobs,
+            cache_dir=args.cache_dir)
     if args.write_baseline:
         save_baseline(baseline_path, result.findings + result.baselined)
         print(f"lint: baselined {len(result.findings + result.baselined)} "
@@ -1673,6 +1686,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog with the historical "
                         "incident each rule guards against")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the safe mechanical fixes (HY001 unused "
+                        "imports, HY002 unreachable code) instead of "
+                        "reporting; loops until stable, refuses "
+                        "suppressed findings, second run is a "
+                        "byte-identical no-op (make lint-fix)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the incremental lint cache (parse "
+                        "pickles + whole-tree findings payloads under "
+                        ".graftlint_cache/)")
+    p.add_argument("--cache-dir", default=".graftlint_cache",
+                   metavar="DIR",
+                   help="incremental cache root (default: "
+                        ".graftlint_cache under the working directory; "
+                        "entries key on content hashes and the rule-"
+                        "pack version, so stale hits are impossible)")
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("predict", help="checkpoint + traffic → utilization")

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# The pre-merge gate (documented in README "Pre-merge gate"): a PR may
+# merge only when BOTH halves pass on the candidate tree.
+#
+#   1. graftlint over the whole repo — findings scoped to the files the
+#      PR changed (the whole project is still parsed so the call graph
+#      and the graftflow value-flow engine keep their interprocedural
+#      context) — emitted as SARIF 2.1.0 (lint.sarif) so the review
+#      system annotates findings inline on the diff.  The warm
+#      .graftlint_cache/ makes the re-runs on push cheap; CI runners
+#      that persist a workspace get the same win.
+#   2. The tier-1 test suite (the exact ROADMAP.md command): the lint
+#      self-check (tests/test_lint_clean.py) rides inside it, pinning
+#      the EMPTY baseline and the 10s lint budget.
+#
+# Usage: bash deploy/ci/lint-gate.sh   (or: make lint-gate)
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+echo "=== lint gate 1/2: graftlint (--changed, SARIF -> lint.sarif) ==="
+python -m deeprest_tpu lint --changed --format sarif | tee lint.sarif \
+    >/dev/null
+# a second, human-readable pass costs ~nothing (warm findings cache)
+python -m deeprest_tpu lint --changed
+
+echo "=== lint gate 2/2: tier-1 tests ==="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit "$rc"

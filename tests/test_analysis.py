@@ -1050,9 +1050,10 @@ def test_cli_list_rules(capsys):
 
 def test_rule_registry_complete():
     rules = all_rules()
-    assert {"JX001", "JX002", "JX003", "JX004",
+    assert {"JX001", "JX002", "JX003", "JX004", "JX005",
+            "JX006", "JX007",
             "TH001", "TH002", "TH003", "TH004",
-            "HY001", "HY002", "OB001", "DN001",
+            "HY001", "HY002", "OB001", "DN001", "DN002",
             "RS001", "RS002", "RS003", "RS004",
             "EX001", "EX002", "EX003"} <= set(rules)
     for rule in rules.values():
@@ -1916,3 +1917,647 @@ def test_parallel_parse_matches_serial(tmp_path, monkeypatch):
     a = lint_project(Project(serial))
     b = lint_project(Project(parallel))
     assert [f.key() for f in a.findings] == [f.key() for f in b.findings]
+
+
+# ---------------------------------------------------------------------------
+# graftflow: the value-flow engine (analysis/dataflow.py) — lattice units
+
+
+def test_absval_join_and_bottom_identity():
+    from deeprest_tpu.analysis.dataflow import AbsVal, BOTTOM, TOP
+
+    a = AbsVal(dtype="f32", domain="device")
+    b = AbsVal(dtype="f64", domain="host", dense=True,
+               origins=(("m.py", 1, 0),))
+    j = a.join(b)
+    # join is the lattice join (least upper bound), NOT dtype promotion
+    assert j.dtype == TOP and j.domain == TOP
+    assert j.dense and j.width is False
+    assert j.origins == (("m.py", 1, 0),)
+    assert BOTTOM.join(a) == a and a.join(BOTTOM) == a
+    assert a.join(a) == a
+
+
+def test_dtype_promotion_lattice():
+    from deeprest_tpu.analysis.dataflow import promote_dtype
+
+    # f64 infects everything it touches
+    assert promote_dtype("f32", "f64") == "f64"
+    assert promote_dtype("bf16", "f64") == "f64"
+    # a weak python scalar never widens a strong array
+    assert promote_dtype("wfloat", "bf16") == "bf16"
+    assert promote_dtype("wfloat", "f32") == "f32"
+    # ...but it DOES float an integer array (the JX006 class)
+    assert promote_dtype("int", "wfloat") == "wfloat"
+    assert promote_dtype("wint", "f32") == "f32"
+    assert promote_dtype("bot", "f32") == "f32"
+
+
+def test_origin_widening_cap():
+    from deeprest_tpu.analysis.dataflow import AbsVal, _MAX_ORIGINS
+
+    a = AbsVal(dense=True,
+               origins=tuple((f"a{i}.py", i, 0) for i in range(3)))
+    b = AbsVal(dense=True,
+               origins=tuple((f"b{i}.py", i, 0) for i in range(3)))
+    j = a.join(b)
+    assert len(j.origins) == _MAX_ORIGINS   # widened, never unbounded
+
+
+def test_tuple_structure_join_and_collapse():
+    from deeprest_tpu.analysis.dataflow import AbsVal, make_tuple
+
+    dense = AbsVal(dense=True, origins=(("m.py", 1, 0),))
+    plain = AbsVal()
+    t1 = make_tuple([dense, plain])
+    t2 = make_tuple([plain, plain])
+    j = t1.join(t2)
+    assert j.elts is not None and len(j.elts) == 2
+    assert j.elts[0].dense and not j.elts[1].dense
+    # arity mismatch collapses structure but keeps the scalar join
+    t3 = make_tuple([plain])
+    k = t1.join(t3)
+    assert k.elts is None and k.dense
+
+
+def test_valueflow_summary_reuse_and_interprocedural_join():
+    from deeprest_tpu.analysis import Project
+    from deeprest_tpu.analysis.core import FuncKey
+    from deeprest_tpu.analysis.dataflow import ValueFlow
+
+    project = Project.from_sources({
+        "serve/a.py": (
+            "from helpers.h import use\n"
+            "import numpy as np\n\n"
+            "def run(capacity):\n"
+            "    buf = np.zeros((4, capacity), np.float32)\n"
+            "    return use(buf)\n"),
+        "serve/b.py": (
+            "from helpers.h import use\n\n"
+            "def other(x):\n"
+            "    return use(x)\n"),
+        "helpers/h.py": "def use(x):\n    return x\n",
+    })
+    vf = ValueFlow.of(project)
+    assert ValueFlow.of(project) is vf      # one engine per Project
+    key = FuncKey("helpers/h.py", None, "use")
+    # the callee's param is the JOIN of both call sites' arguments:
+    # serve/a passes a dense buffer, serve/b an unknown — may-taint wins
+    param = vf.param_summary(key)["x"]
+    assert param.dense and param.origins
+    # ...and the identity return carries the taint back out
+    assert vf.summary_return(key).dense
+    assert vf.rounds_used <= 4              # termination bound held
+
+
+# ---------------------------------------------------------------------------
+# DN002: interprocedural dense taint (graftflow)
+
+
+DN002_BAD = """
+import numpy as np
+
+class Pool:
+    def refresh(self, rows):
+        buf = np.zeros((len(rows), self.capacity), np.float32)
+        return buf
+"""
+
+DN002_GOOD = """
+import numpy as np
+
+class Pool:
+    def refresh(self, rows, kmax):
+        cols = np.zeros((len(rows), kmax), np.int32)
+        return cols
+"""
+
+
+def test_dn002_pair():
+    # an F-trailing host alloc in serve/ fires even though DN001's
+    # watchlist never covered serve/ — the zone itself is the sink
+    assert_pair("DN002", DN002_BAD, DN002_GOOD, rel="serve/pool.py")
+
+
+def test_dn002_cross_module_chain_fires_at_origin():
+    # the dense buffer is allocated in a helper OUTSIDE every watchlist
+    # and reaches the serving plane through a call chain; the finding
+    # anchors at the ORIGIN allocation, in the helper
+    caller = """
+from helpers.alloc import make_buffer
+
+def stage(n, capacity):
+    buf = make_buffer(n, capacity)
+    return buf
+"""
+    callee = """
+import numpy as np
+
+def make_buffer(n, width):
+    return np.zeros((n, width), np.float32)
+"""
+    result = lint_sources({"serve/stage.py": caller,
+                           "helpers/alloc.py": callee},
+                          rules=[all_rules()["DN002"]])
+    assert [(f.path, f.rule) for f in result.findings] == [
+        ("helpers/alloc.py", "DN002")]
+    # same helper with no dense flow into a zone stays silent
+    result = lint_sources({"etl/stage.py": caller,
+                           "helpers/alloc.py": callee},
+                          rules=[all_rules()["DN002"]])
+    assert not result.findings
+
+
+def test_dn002_tuple_unpack_propagation():
+    src = """
+import numpy as np
+
+def build(n, capacity):
+    shape = (n, capacity)
+    bufs = (np.zeros(shape, np.float32), np.zeros((n, 4), np.float32))
+    dense, small = bufs
+    return dense
+"""
+    fired = findings_for("DN002", src, rel="serve/unpack.py")
+    # exactly the F-wide member of the unpacked tuple fires (through a
+    # shape VARIABLE, no literal marker at the alloc site), the small
+    # one stays silent
+    assert len(fired) == 1
+    assert fired[0].line == 6
+
+
+def test_dn002_dn001_sites_have_one_owner():
+    # a marker-shaped alloc inside DN001's own watchlist is DN001's
+    # finding; DN002 must not double-report it
+    fired = findings_for("DN002", DN001_BAD, rel="train/stream.py")
+    assert not fired
+    assert findings_for("DN001", DN001_BAD, rel="train/stream.py")
+
+
+def test_dn002_attribute_store_propagation():
+    # the dense buffer crosses METHODS through the attribute table
+    # (stored in fill(), read through view()) and crosses MODULES into
+    # the serving zone through a resolved Class.method call; the
+    # finding still anchors at the origin allocation in the helper
+    ring = """
+import numpy as np
+
+class Ring:
+    def fill(self, n, capacity):
+        self._buf = np.zeros((n, capacity), np.float32)
+
+    def view(self):
+        return self._buf
+"""
+    reader = """
+from helpers.ring import Ring
+
+def read(r):
+    return Ring.view(r)
+"""
+    result = lint_sources({"helpers/ring.py": ring,
+                           "serve/reader.py": reader},
+                          rules=[all_rules()["DN002"]])
+    assert [(f.path, f.line) for f in result.findings] == [
+        ("helpers/ring.py", 6)]
+    # without the zone-side reader the helper alone stays silent
+    result = lint_sources({"helpers/ring.py": ring},
+                          rules=[all_rules()["DN002"]])
+    assert not result.findings
+
+
+# ---------------------------------------------------------------------------
+# JX006: dtype-promotion hazards inside jit-traced code (graftflow)
+
+
+JX006_BAD = """
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+def make_step():
+    def step(params, x):
+        mask = np.zeros(x.shape)
+        return jnp.sum(x * mask)
+    return jax.jit(step)
+"""
+
+JX006_GOOD = """
+import jax
+import jax.numpy as jnp
+
+def make_step():
+    def step(params, x):
+        mask = jnp.zeros(x.shape, jnp.float32)
+        return jnp.sum(x * mask)
+    return jax.jit(step)
+"""
+
+
+def test_jx006_pair():
+    assert_pair("JX006", JX006_BAD, JX006_GOOD)
+
+
+def test_jx006_helper_reached_through_call_graph():
+    # the f64-defaulting np call hides in a helper the jitted function
+    # calls — the syntactic packs cannot see it, the closure can
+    src = """
+import jax
+import numpy as np
+
+def scale_table(n):
+    return np.linspace(0.0, 1.0, n)
+
+def make_step():
+    def step(params, x):
+        return x * scale_table(4)
+    return jax.jit(step)
+"""
+    fired = findings_for("JX006", src)
+    assert len(fired) == 1 and fired[0].line == 6
+    # explicit dtype silences: the constant is deliberate, no silent f64
+    src_ok = src.replace("np.linspace(0.0, 1.0, n)",
+                         "np.linspace(0.0, 1.0, n, dtype=np.float32)")
+    assert not findings_for("JX006", src_ok)
+
+
+def test_jx006_f64_cast_inside_jit_fires():
+    src = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def step(x):
+    return x.astype(np.float64) * 2.0
+"""
+    fired = findings_for("JX006", src)
+    assert fired and "float64" in fired[0].message
+
+
+def test_jx006_np_outside_jit_is_silent():
+    src = """
+import numpy as np
+
+def host_etl(rows):
+    return np.zeros((len(rows), 8))
+"""
+    assert not findings_for("JX006", src)
+
+
+# ---------------------------------------------------------------------------
+# JX007: transitive host/device crossings (graftflow)
+
+
+JX007_BAD = {
+    "train/trainer.py": """
+from train.helpers import collect
+
+class Trainer:
+    def fit(self, batches):
+        return collect(batches)
+""",
+    "train/helpers.py": """
+import jax.numpy as jnp
+import numpy as np
+
+def collect(batches):
+    out = []
+    for b in batches:
+        dev = jnp.sum(b)
+        out.append(np.asarray(dev))
+    return out
+""",
+}
+
+JX007_GOOD = {
+    "train/trainer.py": JX007_BAD["train/trainer.py"],
+    "train/helpers.py": """
+import jax.numpy as jnp
+import numpy as np
+
+def collect(batches):
+    out = []
+    for b in batches:
+        out.append(jnp.sum(b))
+    return np.asarray(out)
+""",
+}
+
+
+def test_jx007_transitive_readback_pair():
+    result = lint_sources(JX007_BAD, rules=[all_rules()["JX007"]])
+    assert [(f.path, f.line) for f in result.findings] == [
+        ("train/helpers.py", 9)]
+    assert "device" in result.findings[0].message
+    result = lint_sources(JX007_GOOD, rules=[all_rules()["JX007"]])
+    assert not result.findings
+
+
+def test_jx007_unreached_helper_is_silent():
+    # same loop readback, but nothing from the trainer/fused/batcher
+    # entry points reaches it — reachability, not directory, decides
+    sources = {"workload/helpers.py": JX007_BAD["train/helpers.py"]}
+    result = lint_sources(sources, rules=[all_rules()["JX007"]])
+    assert not result.findings
+
+
+def test_jx007_host_value_is_silent():
+    # np.asarray on a value the engine can only prove is HOST data must
+    # not fire — that was JX003's false-positive class, solved here by
+    # the domain lattice instead of suppressions
+    sources = {
+        "train/trainer.py": JX007_BAD["train/trainer.py"],
+        "train/helpers.py": """
+import numpy as np
+
+def collect(batches):
+    out = []
+    for b in batches:
+        row = np.asarray([float(x) for x in b])
+        out.append(row)
+    return out
+""",
+    }
+    result = lint_sources(sources, rules=[all_rules()["JX007"]])
+    assert not result.findings
+
+
+def test_jx007_jx003_watchlist_stays_jx003s():
+    # inside serve/ (JX003's syntactic beat) JX007 must stay silent even
+    # on a proven device readback — one owner per site
+    sources = {
+        "serve/batcher.py": """
+import jax.numpy as jnp
+import numpy as np
+
+def drain(pages):
+    out = []
+    for p in pages:
+        d = jnp.sum(p)
+        out.append(np.asarray(d))
+    return out
+""",
+    }
+    result = lint_sources(sources, rules=[all_rules()["JX007"]])
+    assert not result.findings
+    assert lint_sources(sources,
+                        rules=[all_rules()["JX003"]]).findings
+
+
+# ---------------------------------------------------------------------------
+# DN001-on-graftflow: pre-migration verdicts, bit for bit
+
+
+DN001_PIN_MSG = (
+    "dense traffic allocation with a capacity-wide trailing dimension "
+    "in a sparse-first hot module: carry (cols, vals) padded-COO rows "
+    "and let ops/densify.py scatter on device (suppress with a reason "
+    "only for the pinned dense reference paths)")
+
+
+def test_dn001_verdicts_unchanged_after_dataflow_migration():
+    """DN001 moved onto the value-flow engine's allocation-site table
+    (round 19); these verdicts were captured from the PRE-migration
+    syntactic rule and must reproduce exactly (path, line, col, rule,
+    full message) — the TH001/TH003 round-16 playbook."""
+    expected = {
+        ("train/stream.py", DN001_BAD): [
+            ("train/stream.py", 5, 8, "DN001", DN001_PIN_MSG)],
+        ("data/featurize.py", DN001_BAD): [
+            ("data/featurize.py", 5, 8, "DN001", DN001_PIN_MSG)],
+        ("train/stream.py", DN001_GOOD): [],
+        ("obs/quality.py", DN001_OBS_BAD): [
+            ("obs/quality.py", 6, 17, "DN001", DN001_PIN_MSG)],
+        ("obs/quality.py", DN001_OBS_GOOD): [],
+        ("ops/densify.py", DN001_OBS_BAD): [],
+    }
+    for (rel, src), want in expected.items():
+        result = lint_sources({rel: src}, rules=[all_rules()["DN001"]])
+        got = [(f.path, f.line, f.col, f.rule, f.message)
+               for f in result.findings]
+        assert got == want, f"DN001 verdict drifted for {rel}: {got}"
+
+
+# ---------------------------------------------------------------------------
+# GL004 + the registry audit
+
+
+def test_gl004_uncited_rule_fires():
+    from deeprest_tpu.analysis.core import Rule
+
+    class UncitedRule(Rule):
+        id = "ZZ901"
+        title = "a rule with no citation"
+        guards = ""
+
+        def run(self, project):
+            return iter(())
+
+    result = lint_sources({"mod.py": "x = 1\n"}, rules=[UncitedRule()])
+    gl = [f for f in result.findings if f.rule == "GL004"]
+    assert len(gl) == 1
+    assert "ZZ901" in gl[0].message and "UncitedRule" in gl[0].message
+    assert gl[0].path == "<registry>"   # class not in the linted tree
+
+
+def test_gl004_cited_rules_are_silent():
+    result = lint_sources({"mod.py": "x = 1\n"})
+    assert not [f for f in result.findings if f.rule == "GL004"]
+
+
+def test_registry_audit_every_rule_cited_and_fixtured():
+    """The GL004 contract, enforced at the registry: every registered
+    rule declares its guarded incident AND has a fire+silent fixture
+    pair in this file (assert_pair("<ID>", ...) or <ID>_BAD/<ID>_GOOD
+    constants) — a future pack cannot land uncited or untested."""
+    import os
+
+    src = open(os.path.abspath(__file__), encoding="utf-8").read()
+    for rid, rule in sorted(all_rules().items()):
+        assert rule.title, f"{rid} has no title"
+        assert rule.guards, (
+            f"{rid} has no guarded-incident citation (GL004 would fire "
+            "on any lint run including it)")
+        has_fixtures = (f'assert_pair("{rid}"' in src
+                        or (f"{rid}_BAD" in src and f"{rid}_GOOD" in src))
+        assert has_fixtures, (
+            f"{rid} has no fire/silent fixture pair in "
+            "tests/test_analysis.py")
+
+
+# ---------------------------------------------------------------------------
+# incremental lint cache (analysis/cache.py)
+
+
+def test_cache_warm_hit_matches_cold_run(tmp_path):
+    from deeprest_tpu.analysis.cache import lint_paths_cached
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("import os\n")
+    cache_dir = str(tmp_path / "cache")
+
+    cold, c1 = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert c1 is not None and not c1.result_hit
+    assert [f.rule for f in cold.findings] == ["HY001"]
+
+    warm, c2 = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert c2.result_hit
+    assert ([(f.path, f.line, f.col, f.rule, f.message)
+             for f in warm.findings]
+            == [(f.path, f.line, f.col, f.rule, f.message)
+                for f in cold.findings])
+    assert warm.files == cold.files
+    assert warm.suppressed_count == cold.suppressed_count
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    from deeprest_tpu.analysis.cache import lint_paths_cached
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("import os\n")
+    (proj / "other.py").write_text("VALUE = 1\n")
+    cache_dir = str(tmp_path / "cache")
+    lint_paths_cached([str(proj)], cache_dir=cache_dir)
+
+    (proj / "mod.py").write_text("import os\nprint(os.sep)\n")
+    fixed, cache = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert not cache.result_hit          # whole-tree findings key moved
+    assert not fixed.findings
+    # ...but the untouched file's parse came from the per-file layer
+    assert cache.parse_hits == 1 and cache.parse_misses == 1
+
+
+def test_cache_result_applies_baseline_after_load(tmp_path):
+    # the baseline can change without the tree changing; the cache
+    # stores PRE-baseline findings and re-splits on every load
+    from deeprest_tpu.analysis.cache import lint_paths_cached
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("import os\n")
+    cache_dir = str(tmp_path / "cache")
+    cold, _ = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    key = cold.findings[0].key()
+
+    masked, cache = lint_paths_cached([str(proj)], cache_dir=cache_dir,
+                                      baseline_keys=[key])
+    assert cache.result_hit
+    assert not masked.findings and len(masked.baselined) == 1
+
+
+def test_cache_suppression_edit_invalidates(tmp_path):
+    # suppressions live in file content, so the content hash covers
+    # them: adding one must flip the verdict even with a warm cache
+    from deeprest_tpu.analysis.cache import lint_paths_cached
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("import os\n")
+    cache_dir = str(tmp_path / "cache")
+    cold, _ = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert cold.findings
+    (proj / "mod.py").write_text(
+        "# graftlint: disable=HY001 -- doc example import\n"
+        "import os\n")
+    after, _ = lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert not after.findings and after.suppressed_count == 1
+
+
+# ---------------------------------------------------------------------------
+# deeprest lint --fix (analysis/autofix.py)
+
+
+def test_lint_fix_round_trip(tmp_path):
+    """The acceptance contract: fix → re-lint reports zero HY001/HY002
+    → a second fix is a byte-identical no-op."""
+    from deeprest_tpu.analysis import fix_paths, lint_paths
+
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import os\n"
+        "import sys, json\n"
+        "from collections import OrderedDict, defaultdict\n"
+        "\n"
+        "def f(x):\n"
+        "    return os.path.join('a', x)\n"
+        "    y = json.dumps(x)\n"
+        "    return y\n"
+        "\n"
+        "def g():\n"
+        "    return defaultdict(list)\n")
+    report = fix_paths([str(tmp_path)])
+    assert report.applied
+    result = lint_paths([str(tmp_path)],
+                        rules=[all_rules()["HY001"],
+                               all_rules()["HY002"]])
+    assert not result.findings, render_text(result)
+    before = mod.read_bytes()
+    again = fix_paths([str(tmp_path)])
+    assert mod.read_bytes() == before    # byte-identical no-op
+    assert not again.applied
+
+
+def test_lint_fix_cascade_unreachable_then_import(tmp_path):
+    # deleting unreachable code orphans the import it was the only user
+    # of; the fixer loops until stable and catches both
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import json\n"
+        "\n"
+        "def f(x):\n"
+        "    return x\n"
+        "    return json.dumps(x)\n")
+    from deeprest_tpu.analysis import fix_paths
+
+    report = fix_paths([str(tmp_path)])
+    assert report.passes >= 2
+    text = mod.read_text()
+    assert "json" not in text
+    assert not findings_for("HY001", text)
+    assert not findings_for("HY002", text)
+
+
+def test_lint_fix_refuses_suppressed_findings(tmp_path):
+    from deeprest_tpu.analysis import fix_paths
+
+    mod = tmp_path / "mod.py"
+    original = ("# graftlint: disable=HY001 -- doc example, kept\n"
+                "import os\n")
+    mod.write_text(original)
+    report = fix_paths([str(tmp_path)])
+    assert mod.read_text() == original   # a documented deviation stays
+    assert not report.applied
+    assert any(e.rule == "HY001" for e in report.refused)
+
+
+def test_lint_fix_only_statement_becomes_pass(tmp_path):
+    from deeprest_tpu.analysis import fix_paths
+
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f():\n    import os\n")
+    fix_paths([str(tmp_path)])
+    import ast as ast_mod
+
+    text = mod.read_text()
+    ast_mod.parse(text)                  # still a valid module
+    assert "import os" not in text and "pass" in text
+
+
+def test_cli_lint_fix_and_no_cache(tmp_path, capsys):
+    from deeprest_tpu.cli import build_parser
+
+    mod = tmp_path / "mod.py"
+    mod.write_text("import os\n")
+    parser = build_parser()
+    args = parser.parse_args(["lint", "--fix", str(tmp_path)])
+    assert args.fn(args) == 0
+    out = capsys.readouterr().out
+    assert "fixed HY001" in out
+    assert "import os" not in mod.read_text()
+
+    # --no-cache still lints (now clean) with exit 0
+    args = parser.parse_args(["lint", "--no-cache", str(tmp_path)])
+    assert args.fn(args) == 0
