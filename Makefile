@@ -39,11 +39,15 @@
 #                         rides benchmarks/tpu_queue.sh
 #   make chaos-bench      the kill-under-load chaos storm gate (SIGKILL
 #                         worker replicas + scheduled thread-replica
-#                         ejections under live HTTP load: zero wrong
-#                         answers, bounded 429/503, auto-rejoin, zero
-#                         leaked threads/processes/fds) — refreshes
-#                         benchmarks/chaos_bench.json; the on-chip storm
-#                         rides benchmarks/tpu_queue.sh chaos_storm tenk_vertical
+#                         ejections under live HTTP load, plus the
+#                         elastic arm's injected device losses
+#                         mid-training: zero wrong answers, bounded
+#                         429/503, auto-rejoin, remesh bit-identical to
+#                         restart-resume, zero leaked threads/processes/
+#                         fds/device buffers) — refreshes
+#                         benchmarks/chaos_bench.json; the on-chip
+#                         storms ride benchmarks/tpu_queue.sh
+#                         chaos_storm + elastic_remesh
 #   make drift-bench      the model-quality observability gate (topology
 #                         shift detection latency, ransomware-mid-drift,
 #                         clean-corpus zero verdicts, <=3% monitor

@@ -747,8 +747,30 @@ def main() -> None:
     except Exception as exc:
         print(f"bench: drift measurement failed: {exc}", file=sys.stderr)
 
+    # Elastic-remesh recovery headline (schema v11, NEW key): the worst
+    # detect->rebuild->restore wall time across the committed chaos
+    # storm's elastic arm (benchmarks/chaos_bench.json — `make
+    # chaos-bench` refreshes it; the arm's own gates pin bit-identical
+    # params and the zero-leak census).  Read from the committed
+    # artifact like tenk_peak_rss_mb: the storm is minutes of wall time
+    # and belongs to its own bench, not this headline's budget.
+    remesh_recovery = None
+    try:
+        with open(os.path.join(REPO, "benchmarks", "chaos_bench.json"),
+                  encoding="utf-8") as f:
+            remesh_recovery = (json.load(f)["arms"]["elastic"]
+                               ["max_recovery_s"])
+    except Exception:
+        pass
+
     perf = _mfu_block(measured, F)
     result = {
+        # v11: remesh_recovery_s is the elastic-remeshing recovery
+        # headline (worst detect->rebuild->restore wall seconds from the
+        # committed chaos_bench.json elastic arm, whose own gates pin
+        # bit-identical-to-restart-resume params, executables flat
+        # across remeshes, and a zero-leak census incl. live device
+        # buffers) — a NEW key only; every v10 key keeps its meaning.
         # v10: the model-quality observability tier adds
         # drift_detection_sweeps (windows-to-flag on the quick
         # topology-shift corpus — benchmarks/drift_bench.py detection
@@ -793,7 +815,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 10,
+        "schema_version": 11,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -851,6 +873,8 @@ def main() -> None:
         result["drift_detection_sweeps"] = round(drift_detection, 2)
     if drift_overhead is not None:
         result["drift_overhead_pct"] = round(drift_overhead, 3)
+    if remesh_recovery is not None:
+        result["remesh_recovery_s"] = round(float(remesh_recovery), 4)
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

@@ -124,26 +124,42 @@ def _warm_multiprocessing() -> None:
 
 
 def _census() -> dict:
+    import gc
+
+    gc.collect()     # drop cycles so the device-buffer count is honest
     for _ in multiprocessing.active_children():   # reaps exited workers
         pass
+    # Live DEVICE buffers join the census (round 20): a remesh that
+    # strands old-mesh arrays — or a closed plane whose predictor stacks
+    # stay referenced — leaks HBM the thread/fd census cannot see (the
+    # round-17 fd audit caught a real Popen-sentinel leak; device memory
+    # gets the same treatment).
+    try:
+        import jax
+
+        buffers = len(jax.live_arrays())
+    except Exception:
+        buffers = 0
     return {
         "threads": threading.active_count(),
         "children": len(multiprocessing.active_children()),
         "fds": len(os.listdir("/proc/self/fd")),
+        "device_buffers": buffers,
     }
 
 
 def _settled_census(baseline: dict, timeout_s: float = 15.0) -> dict:
     """Post-storm census with a settle loop: batcher workers, HTTP
-    handler threads, and SIGCHLD reaping all finish asynchronously after
-    close() — poll until the counts return to baseline (or report the
-    stuck values)."""
+    handler threads, SIGCHLD reaping, and device-buffer frees all finish
+    asynchronously after close() — poll until the counts return to
+    baseline (or report the stuck values)."""
     deadline = time.monotonic() + timeout_s
     while True:
         now = _census()
         clean = (now["threads"] <= baseline["threads"]
                  and now["children"] <= baseline["children"]
-                 and now["fds"] <= baseline["fds"])
+                 and now["fds"] <= baseline["fds"]
+                 and now["device_buffers"] <= baseline["device_buffers"])
         if clean or time.monotonic() > deadline:
             return {"before": baseline, "after": now, "clean": clean}
         time.sleep(0.2)
@@ -299,6 +315,10 @@ def _run_arm(kind: str, *, replicas: int, duration_s: float,
     final_ok = final["predictions"] == reference_json
 
     server.stop()
+    # Release the plane before the census: the router's replica stacks
+    # (and their device-resident params) are exactly what the
+    # device-buffer column must see freed.
+    router = service = server = None  # noqa: F841
     leak = _settled_census(baseline)
 
     with stats.lock:
@@ -343,16 +363,245 @@ def _run_arm(kind: str, *, replicas: int, duration_s: float,
     return arm
 
 
+# ---------------------------------------------------------------------------
+# elastic arm: storm injected device losses mid-TRAINING (round 20)
+
+
+def _series_corpus(n: int, seed: int):
+    """A traffic-correlated synthetic corpus long enough for windowed
+    training (the bench's self-contained twin of the test fixtures)."""
+    from deeprest_tpu.data.schema import Bucket, MetricSample, Span
+
+    rng = np.random.default_rng(seed)
+    buckets = []
+    for t in range(n):
+        load = 2.0 + np.sin(2 * np.pi * t / 24.0) + rng.uniform(-0.2, 0.2)
+        nc = max(0, int(rng.poisson(load)))
+        nr = max(0, int(rng.poisson(2 * load)))
+        traces = [Span(component="gateway", operation="/compose",
+                       children=[Span(component="store-svc",
+                                      operation="/store")])
+                  for _ in range(nc)]
+        traces += [Span(component="gateway", operation="/read")
+                   for _ in range(nr)]
+        metrics = [
+            MetricSample("gateway", "cpu",
+                         10.0 * nc + 3.0 * nr + rng.normal(0, 0.5)),
+            MetricSample("store-db", "wiops",
+                         25.0 * nc + rng.normal(0, 1.0)),
+        ]
+        buckets.append(Bucket(metrics=metrics, traces=traces))
+    return buckets
+
+
+def _elastic_train_cfg(ckpt_dir: str, superstep: int, accum: int,
+                       elastic: bool):
+    from deeprest_tpu.config import Config, ModelConfig, TrainConfig
+
+    return Config(
+        model=ModelConfig(hidden_size=8, dropout_rate=0.5),
+        train=TrainConfig(
+            num_epochs=2, batch_size=16, window_size=12,
+            eval_stride=12, eval_max_cycles=2, seed=0,
+            device_data="always", steps_per_superstep=superstep,
+            grad_accum_windows=accum, log_every_steps=0,
+            checkpoint_dir=str(ckpt_dir), snapshot_every_steps=2,
+            elastic=elastic, remesh_backoff_ms=1.0,
+            remesh_max_attempts=4))
+
+
+def _state_leaves(state):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(state)]
+
+
+def _run_elastic_scenario(name: str, corpus, workdir: str, *,
+                          superstep: int, accum: int,
+                          losses: dict[int, int]) -> dict:
+    """One elastic storm cell: the same device-loss schedule through the
+    round-17 restart-resume path (fresh process per loss — the
+    reference) and through the in-process elastic barrier, then compare
+    final params BIT-for-bit.
+
+    The reference chain uses the same FaultInjector (raising BEFORE any
+    cursor bookkeeping) as a crash stand-in, so both paths see the same
+    newest durable snapshot at each loss — the parity the round-20
+    contract pins.
+    """
+    import shutil
+    import time
+
+    from deeprest_tpu.config import MeshConfig
+    from deeprest_tpu.parallel import DeviceLossError, FaultInjector
+    from deeprest_tpu.parallel.mesh import make_mesh, shrink_mesh_config
+    from deeprest_tpu.train import Trainer, prepare_dataset
+
+    schedule = sorted(losses.items())
+    ref_dir = os.path.join(workdir, f"{name}-ref")
+    ela_dir = os.path.join(workdir, f"{name}-elastic")
+    for d in (ref_dir, ela_dir):
+        shutil.rmtree(d, ignore_errors=True)
+
+    # -- reference: the round-17 path — every loss kills the "process",
+    # a fresh Trainer on the survivor mesh resumes from the newest
+    # cursor snapshot
+    cfg_ref = _elastic_train_cfg(ref_dir, superstep, accum, elastic=False)
+    bundle = prepare_dataset(corpus, cfg_ref.train)
+    t0 = time.monotonic()
+    data_axis = 8
+    state_ref = hist_ref = tr_ref = None
+    for i in range(len(schedule) + 1):
+        tr_ref = Trainer(cfg_ref, bundle.feature_dim, bundle.metric_names,
+                         mesh=make_mesh(MeshConfig(data=data_axis)))
+        if i < len(schedule):
+            tr_ref.install_fault_injector(
+                FaultInjector(dict([schedule[i]])))
+        try:
+            if i == 0:
+                state_ref, hist_ref = tr_ref.fit(bundle)
+            else:
+                state_ref, hist_ref = tr_ref.resume_training(bundle)
+            break
+        except DeviceLossError:
+            data_axis = shrink_mesh_config(
+                MeshConfig(data=data_axis),
+                data_axis - schedule[i][1]).data
+    wall_ref = time.monotonic() - t0
+    ref_cache = tr_ref._jit_cache_size()
+    ref_leaves = _state_leaves(state_ref)
+    ref_final_loss = hist_ref[-1].test_loss
+    del state_ref, hist_ref, tr_ref
+
+    # -- elastic: ONE trainer, same schedule, in-process recovery
+    cfg_ela = _elastic_train_cfg(ela_dir, superstep, accum, elastic=True)
+    tr = Trainer(cfg_ela, bundle.feature_dim, bundle.metric_names,
+                 mesh=make_mesh(MeshConfig(data=8)))
+    tr.install_fault_injector(FaultInjector(dict(schedule)))
+    t0 = time.monotonic()
+    state, hist = tr.fit(bundle)
+    wall_ela = time.monotonic() - t0
+    ela_cache = tr._jit_cache_size()
+    ela_leaves = _state_leaves(state)
+    bit_identical = (len(ref_leaves) == len(ela_leaves)
+                     and all(np.array_equal(a, b)
+                             for a, b in zip(ref_leaves, ela_leaves)))
+    cell = {
+        "kill_steps": {str(k): v for k, v in schedule},
+        "mesh_path": "8x1x1 -> " + " -> ".join(
+            f"{r['mesh']['data']}x{r['mesh']['expert']}x{r['mesh']['model']}"
+            for r in tr.remesh_history),
+        "remeshes": tr.remesh_count,
+        "expected_remeshes": len(schedule),
+        "bit_identical": bool(bit_identical),
+        "final_test_loss_equal": bool(hist[-1].test_loss
+                                      == ref_final_loss),
+        "recoveries_s": [round(r["recovery_s"], 4)
+                         for r in tr.remesh_history],
+        "restored_steps": [r["restored_step"]
+                           for r in tr.remesh_history],
+        # one program set per live mesh shape: the elastic trainer's jit
+        # caches after the storm must not exceed what a FRESH trainer on
+        # the final mesh compiled (the reference chain's last trainer) —
+        # any excess would be per-remesh or per-step recompilation
+        "jit_executables": {"elastic": ela_cache, "reference": ref_cache},
+        "executables_flat": (ela_cache is None or ref_cache is None
+                             or ela_cache <= ref_cache),
+        "wall_elastic_s": round(wall_ela, 3),
+        "wall_reference_s": round(wall_ref, 3),
+    }
+    del state, hist, tr, ref_leaves, ela_leaves, bundle
+    return cell
+
+
+def _run_elastic_arm(*, quick: bool, seed: int,
+                     recovery_envelope_s: float) -> dict:
+    """The elastic storm: injected device losses mid-training — per-step,
+    mid-superstep, and mid-grad-accum — each cell gated on bit-identical
+    final params vs the restart-resume reference, bounded recovery,
+    executables flat across remeshes, and a zero-leak census (threads,
+    fds, children, live device buffers: a remesh must not strand
+    old-mesh arrays)."""
+    import tempfile
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        # A single attached chip cannot lose half of itself; the storm
+        # needs a multi-device slice (the CPU backend forces 8 virtual
+        # devices for exactly this).
+        return {"skipped": f"needs >= 8 devices, have "
+                           f"{len(jax.devices())}",
+                "pass": True}
+
+    from deeprest_tpu.config import FeaturizeConfig
+    from deeprest_tpu.data.featurize import featurize_buckets
+
+    baseline = _census()
+    corpus = featurize_buckets(_series_corpus(140, seed=7),
+                               FeaturizeConfig(round_to=8))
+    scenarios = {
+        # two losses through the fused superstep path: 8 -> 4 -> 2
+        "superstep": dict(superstep=2, accum=1, losses={3: 4, 7: 2}),
+        # mid-grad-accum: the coalesced group's dispatch is the failing
+        # unit (G=2 microbatches per update)
+        "grad_accum": dict(superstep=2, accum=2, losses={3: 4}),
+    }
+    if not quick:
+        # the per-step dispatch path (no scan fusion)
+        scenarios["per_step"] = dict(superstep=1, accum=1,
+                                     losses={3: 4})
+    cells = {}
+    with tempfile.TemporaryDirectory(prefix="chaos-elastic-") as workdir:
+        for cell_name, spec in scenarios.items():
+            cells[cell_name] = _run_elastic_scenario(
+                cell_name, corpus, workdir, **spec)
+    del corpus
+    leak = _settled_census(baseline)
+    recoveries = [r for c in cells.values() for r in c["recoveries_s"]]
+    arm = {
+        "scenarios": cells,
+        "remeshes": sum(c["remeshes"] for c in cells.values()),
+        "bit_identical": all(c["bit_identical"] for c in cells.values()),
+        "executables_flat": all(c["executables_flat"]
+                                for c in cells.values()),
+        "max_recovery_s": (round(max(recoveries), 4)
+                           if recoveries else None),
+        "recovery_envelope_s": recovery_envelope_s,
+        "leak": leak,
+    }
+    arm["pass"] = bool(
+        arm["bit_identical"]
+        and arm["executables_flat"]
+        and all(c["remeshes"] == c["expected_remeshes"]
+                for c in cells.values())
+        and all(c["final_test_loss_equal"] for c in cells.values())
+        and arm["max_recovery_s"] is not None
+        and arm["max_recovery_s"] <= recovery_envelope_s
+        and leak["clean"])
+    return arm
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="tier-1-sized storm (fewer replicas, kills, "
                          "seconds) — plumbing + gates, not endurance")
-    ap.add_argument("--arms", default="thread,process",
+    ap.add_argument("--arms", default="thread,process,elastic",
                     help="comma list of storm arms to run")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+
+    wanted = [a.strip() for a in args.arms.split(",") if a.strip()]
+    if "elastic" in wanted and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # The elastic storm needs a mesh that can LOSE devices; on the
+        # CPU backend that means 8 virtual devices, set before the first
+        # jax import (no effect on accelerator platforms).
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=8").strip()
 
     import jax
 
@@ -363,8 +612,16 @@ def main(argv=None) -> int:
     # that honestly rather than pretending chip-grade failover
     recovery_envelope_s = 90.0
     arms = {}
-    for kind in [a.strip() for a in args.arms.split(",") if a.strip()]:
-        if kind == "thread":
+    for kind in wanted:
+        if kind == "elastic":
+            # in-process device-loss storm on the TRAINING plane; the
+            # envelope covers restore (detect->rebuild->restore legs);
+            # the first post-restore dispatch additionally pays one
+            # compile per new mesh shape (reported in wall_elastic_s)
+            arms[kind] = _run_elastic_arm(
+                quick=quick, seed=args.seed,
+                recovery_envelope_s=30.0)
+        elif kind == "thread":
             arms[kind] = _run_arm(
                 "thread",
                 replicas=2 if quick else 4,
@@ -390,14 +647,22 @@ def main(argv=None) -> int:
             ap.error(f"unknown arm {kind!r}")
 
     result = {
-        "schema_version": 1,
+        # v2: the elastic arm joins (in-process device-loss storm on the
+        # training plane: bit-identical-to-restart-resume, bounded
+        # recovery, executables flat across remeshes) and every census
+        # gains a live device-buffer column — NEW arm + NEW census key
+        # only; every v1 key keeps its meaning.
+        "schema_version": 2,
         "quick": quick,
         "platform": jax.default_backend(),
         "honest_cpu": (
             "all replicas share one host core; worker reboot time is "
             "dominated by the child's jax import — throughput/latency "
             "cells are plumbing proofs, the gates (zero wrong answers, "
-            "bounded errors, rejoin, zero leaks) are the product"),
+            "bounded errors, rejoin, zero leaks) are the product.  The "
+            "elastic arm's recovery seconds are CPU restore times "
+            "(tiny model, local disk); on hardware the same legs add "
+            "real HBM restore + per-shape XLA compiles"),
         "arms": arms,
         "pass": bool(arms) and all(a["pass"] for a in arms.values()),
     }

@@ -113,7 +113,18 @@ step tenk_vertical 2400 env JAX_PLATFORMS=tpu python \
 # chip; worker subprocesses keep the CPU backend (two processes cannot
 # share one TPU chip — serve_bench's one-worker-per-host note applies).
 step chaos_storm 1800 env JAX_PLATFORMS=tpu python \
-  benchmarks/chaos_bench.py --out benchmarks/chaos_bench_tpu.json
+  benchmarks/chaos_bench.py --arms thread,process \
+  --out benchmarks/chaos_bench_tpu.json
+# Elastic remeshing on-chip (round 20): the committed CPU elastic arm
+# proves bit-identical-to-restart-resume recovery on the 8-virtual-
+# device mesh; on hardware the number that matters is the real recovery
+# time — HBM-scale cross-mesh restore plus one XLA compile per new mesh
+# shape — and the arm self-skips (pass with "skipped") on slices with
+# fewer than 8 attached devices, so this step only banks a number on a
+# multi-chip window.
+step elastic_remesh 1800 env JAX_PLATFORMS=tpu python \
+  benchmarks/chaos_bench.py --arms elastic \
+  --out benchmarks/chaos_bench_elastic_tpu.json
 # Observability overhead on-chip (round 14): the committed CPU
 # obs_bench.json proves the <=3% budget where spans are a visible
 # fraction of a millisecond-scale call; on the accelerator, per-call

@@ -2,7 +2,7 @@
 
 The chaos half of ROADMAP item 7 will kill replicas and preempt slices
 under live load; the static half is proving that an exception anywhere on
-the hot path cannot strand the plane.  Three ways it historically could:
+the hot path cannot strand the plane.  Four ways it historically could:
 
 - EX001 — a bare ``lock.acquire()`` whose ``release()`` is not reached on
   a raising path (beyond TH004's per-attribute discipline: TH004 proves
@@ -20,6 +20,13 @@ the hot path cannot strand the plane.  Three ways it historically could:
   is silently discarded exactly where the obs plane (round 14) exists to
   surface it.  Narrow, typed excepts with a pass body are a deliberate
   idiom (best-effort shutdown sends) and stay silent.
+- EX004 — the device-loss family (``XlaRuntimeError``/``DeviceLossError``
+  explicitly, or a broad except around a step/superstep/jit dispatch)
+  caught in ``train/``/``parallel/`` and neither re-raised nor routed to
+  the remesh handler: the round-20 elastic fault barrier must stay the
+  ONLY swallow point for device loss — a second one quietly turns a
+  recoverable preemption into corrupted training state (the dispatch's
+  progress is gone but the cursor marches on).
 
 EX001/EX002 ride the same path-sensitive paired-operation walker as the
 RS pack (core.ObligationWalker) — through try/finally, with, early
@@ -29,6 +36,7 @@ return, and raise edges.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from deeprest_tpu.analysis.core import (
@@ -232,3 +240,100 @@ class EX003SwallowedException(Rule):
             return any(dotted_name(e) in self._BROAD
                        for e in type_node.elts)
         return False
+
+
+@register
+class EX004DeviceLossSwallowedOutsideBarrier(Rule):
+    id = "EX004"
+    title = ("device-loss exception caught outside the elastic fault "
+             "barrier (neither re-raised nor routed to the remesh "
+             "handler)")
+    guards = ("round 20 (elastic remeshing): the fault barrier "
+              "(Trainer._run_epochs_elastic and the stream's refresh "
+              "twin) is the ONLY sanctioned swallow point for the "
+              "device-loss family — it restores the newest cursor "
+              "snapshot, so nothing from the failed dispatch survives.  "
+              "A second catch site that logs-and-continues keeps the "
+              "old cursor marching over a dispatch that never happened: "
+              "silently corrupted training state, the exact class the "
+              "kill-at-step-K bit-parity contract exists to exclude.  "
+              "Handlers that re-raise, or route to a "
+              "remesh/device-loss handler, are the barrier and stay "
+              "silent")
+
+    HOT_DIRS = ("train", "parallel")
+    # explicit device-loss family (terminal name of the except type)
+    _FAMILY = ("XlaRuntimeError", "JaxRuntimeError", "DeviceLossError")
+    _BROAD = ("Exception", "BaseException")
+    # a broad except is only the family when its try body holds a
+    # jit-dispatch-looking call — the shape the barrier wraps
+    _DISPATCH_RE = re.compile(r"(?i)(step|dispatch|\bjit\b)")
+    # routing a caught loss to the remesh machinery discharges it
+    _HANDLER_RE = re.compile(r"(?i)(remesh|device_loss)")
+
+    def _is_hot(self, rel: str) -> bool:
+        parts = rel.replace("\\", "/").split("/")
+        return any(d in parts[:-1] for d in self.HOT_DIRS)
+
+    @staticmethod
+    def _terminal(node: ast.AST | None) -> str | None:
+        name = dotted_name(node) if node is not None else None
+        return name.rsplit(".", 1)[-1] if name else None
+
+    def _type_names(self, type_node: ast.AST | None) -> list[str | None]:
+        if type_node is None:
+            return [None]                      # bare except
+        if isinstance(type_node, ast.Tuple):
+            return [self._terminal(e) for e in type_node.elts]
+        return [self._terminal(type_node)]
+
+    def _try_dispatches(self, try_node: ast.Try) -> bool:
+        for stmt in try_node.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = self._terminal(node.func)
+                    if name and self._DISPATCH_RE.search(name):
+                        return True
+        return False
+
+    def _handler_discharges(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = self._terminal(node.func)
+                if name and self._HANDLER_RE.search(name):
+                    return True
+        return False
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        for sf in project.files:
+            if sf.tree is None or not self._is_hot(sf.rel):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    names = self._type_names(handler.type)
+                    explicit = [n for n in names if n in self._FAMILY]
+                    broad = any(n is None or n in self._BROAD
+                                for n in names)
+                    if explicit:
+                        what = f"except {'/'.join(explicit)}"
+                    elif broad and self._try_dispatches(node):
+                        what = ("broad except around a step/superstep "
+                                "dispatch")
+                    else:
+                        continue
+                    if self._handler_discharges(handler):
+                        continue
+                    yield sf.finding(
+                        handler, self.id,
+                        f"{what} swallows the device-loss family "
+                        "outside the elastic fault barrier: the failed "
+                        "dispatch's progress is gone but this handler "
+                        "continues with the old cursor — re-raise, or "
+                        "route to the remesh handler "
+                        "(_handle_device_loss), which restores the "
+                        "newest snapshot; the barrier must stay the "
+                        "only swallow point")

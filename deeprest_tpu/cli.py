@@ -109,6 +109,36 @@ def _add_sparse_args(p: argparse.ArgumentParser, serving: bool = False):
                         "paths (default 64)")
 
 
+def _add_elastic_args(p: argparse.ArgumentParser, streaming: bool = False):
+    what = ("the interrupted refresh defers through the remesh and "
+            "completes (never dropped)" if streaming else
+            "the continuation is bit-identical to killing the process "
+            "and resuming on the survivor mesh")
+    p.add_argument("--elastic", action="store_true",
+                   help="survive device loss IN-PROCESS (elastic "
+                        "remeshing): catch device-loss failures at the "
+                        "step dispatch, shrink the mesh's data axis over "
+                        "the surviving devices (expert/model preserved), "
+                        "restore the newest cursor snapshot through the "
+                        "cross-mesh assembly, and continue — "
+                        f"{what}; requires --snapshot-every-steps >= 1")
+    p.add_argument("--remesh-max-attempts", type=int, default=3,
+                   metavar="N",
+                   help="device losses one run may recover from before "
+                        "the barrier surfaces the failure instead of "
+                        "respinning (default 3)")
+    p.add_argument("--remesh-backoff-ms", type=float, default=100.0,
+                   metavar="MS",
+                   help="backoff slept before each remesh rebuild, "
+                        "scaled by the attempt number (default 100)")
+    p.add_argument("--snapshot-keep", type=int, default=3, metavar="K",
+                   help="newest cursor snapshots retained (snapshot "
+                        "retention GC; pruning runs only after a durable "
+                        "newer save and never touches the restore "
+                        "target or non-cursor checkpoints; 0 = keep "
+                        "everything, the historical behavior)")
+
+
 def _add_mesh_arg(p: argparse.ArgumentParser, serving: bool = False):
     extra = (" (serving: shardings resolve from the same partition-rule "
              "table training pins with — parallel/sharding.py — so "
@@ -351,7 +381,11 @@ def cmd_train(args) -> int:
                           grad_accum_mode=args.grad_accum_mode,
                           sparse_feed=args.sparse_feed,
                           sparse_nnz_cap=args.sparse_nnz_cap,
-                          snapshot_every_steps=args.snapshot_every_steps),
+                          snapshot_every_steps=args.snapshot_every_steps,
+                          snapshot_keep=args.snapshot_keep,
+                          elastic=args.elastic,
+                          remesh_max_attempts=args.remesh_max_attempts,
+                          remesh_backoff_ms=args.remesh_backoff_ms),
         mesh=mesh_cfg,
     )
     bundle = prepare_dataset(data, cfg.train)
@@ -532,7 +566,11 @@ def cmd_stream(args) -> int:
                           grad_accum_mode=args.grad_accum_mode,
                           sparse_feed=args.sparse_feed,
                           sparse_nnz_cap=args.sparse_nnz_cap,
-                          snapshot_every_steps=args.snapshot_every_steps),
+                          snapshot_every_steps=args.snapshot_every_steps,
+                          snapshot_keep=args.snapshot_keep,
+                          elastic=args.elastic,
+                          remesh_max_attempts=args.remesh_max_attempts,
+                          remesh_backoff_ms=args.remesh_backoff_ms),
         etl=EtlConfig(overlap=not args.no_etl_overlap,
                       queue_depth=args.etl_queue_depth),
         quality=quality or QualityConfig(),
@@ -1329,6 +1367,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "same command after a kill resumes the run — "
                         "onto whatever mesh remains — bit-identical to "
                         "an uninterrupted run at the same step (0 = off)")
+    _add_elastic_args(p)
     _add_sparse_args(p)
     _add_mesh_arg(p)
     p.add_argument("--ckpt-dir", default=None)
@@ -1413,6 +1452,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps stale instead of losing the refresh "
                         "(0 = off; refresh-end checkpoints always "
                         "happen)")
+    _add_elastic_args(p, streaming=True)
     _add_sparse_args(p)
     p.add_argument("--refresh-buckets", type=int, default=60,
                    help="fine-tune after this many new buckets")

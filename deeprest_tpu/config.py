@@ -171,6 +171,35 @@ class TrainConfig:
     # (tests/test_chaos.py).  0 = off (the historical behavior; epoch-
     # cadence checkpoints only).
     snapshot_every_steps: int = 0
+    # Snapshot retention GC: keep only the newest this-many CURSOR
+    # snapshots (the preemption-resume anchors) — snapshot_every_steps
+    # used to accumulate checkpoints unboundedly.  Pruning happens only
+    # AFTER a durable newer save and never touches the newest (restore-
+    # target) snapshots or non-cursor checkpoints (epoch-cadence saves,
+    # streaming refresh checkpoints — the stream's keep_checkpoints owns
+    # those).  0 = unlimited (the historical behavior).
+    snapshot_keep: int = 3
+    # Elastic remeshing (ROADMAP item 7's last training gap): survive
+    # device loss IN-PROCESS.  The fault barrier around the step/
+    # superstep dispatch catches the device-loss family (real
+    # XlaRuntimeError device errors on hardware; the deterministic
+    # FaultInjector's DeviceLossError on CPU), re-enumerates healthy
+    # devices, rebuilds the mesh (data axis shrinks by divisors,
+    # expert/model preserved — parallel/mesh.shrink_mesh_config),
+    # re-derives every sharding from the one rule table, restores the
+    # newest fsync'd cursor snapshot through the cross-mesh assembly,
+    # re-stages the epoch plan onto the new mesh, and continues — the
+    # post-remesh trajectory is BIT-IDENTICAL to killing the process and
+    # running resume_training on the survivor mesh (tests/test_chaos.py).
+    # Requires cursor snapshots (snapshot_every_steps >= 1 and a
+    # checkpoint_dir at fit time).
+    elastic: bool = False
+    # Bounded recovery: total remeshes one fit() may perform before the
+    # barrier surfaces RemeshExhaustedError instead of respinning (the
+    # RS004 discipline on the training plane), and the backoff slept
+    # before each rebuild (scaled by the attempt number).
+    remesh_max_attempts: int = 3
+    remesh_backoff_ms: float = 100.0
 
     def __post_init__(self):
         v = self.steps_per_superstep
@@ -199,6 +228,28 @@ class TrainConfig:
             raise ValueError(
                 f"TrainConfig.snapshot_every_steps={s!r}: must be an "
                 f"int >= 0 (0 = snapshots off)")
+        k = self.snapshot_keep
+        if not isinstance(k, int) or isinstance(k, bool) or k < 0:
+            raise ValueError(
+                f"TrainConfig.snapshot_keep={k!r}: must be an int >= 0 "
+                "(0 = unlimited retention)")
+        a = self.remesh_max_attempts
+        if not isinstance(a, int) or isinstance(a, bool) or a < 1:
+            raise ValueError(
+                f"TrainConfig.remesh_max_attempts={a!r}: must be an "
+                "int >= 1 (the barrier must stay bounded)")
+        if not isinstance(self.remesh_backoff_ms, (int, float)) \
+                or isinstance(self.remesh_backoff_ms, bool) \
+                or self.remesh_backoff_ms < 0:
+            raise ValueError(
+                f"TrainConfig.remesh_backoff_ms="
+                f"{self.remesh_backoff_ms!r}: must be a number >= 0")
+        if self.elastic and self.snapshot_every_steps < 1:
+            raise ValueError(
+                "TrainConfig.elastic=True requires snapshot_every_steps "
+                ">= 1: the remesh barrier restores from cursor "
+                "snapshots; without them a device loss would restart "
+                "training from scratch silently")
         if self.sparse_feed and self.device_data == "off":
             raise ValueError(
                 "TrainConfig.sparse_feed=True requires the staged "

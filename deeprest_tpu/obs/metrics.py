@@ -287,9 +287,18 @@ class MetricsRegistry:
         with self._lock:
             self._collectors[name] = fn
 
-    def unregister_collector(self, name: str) -> None:
+    def unregister_collector(self, name: str,
+                             fn: Callable[[SampleSink], None] | None = None
+                             ) -> None:
+        """Drop a render-time collector.  Pass the registering ``fn`` to
+        make the removal conditional: a closing plane must drop ITS OWN
+        collector (the registry is process-wide — a registered bound
+        method pins the closed plane, and every device buffer behind it,
+        forever: the round-20 device-buffer census caught exactly this)
+        without clobbering a rebuilt plane's newer registration."""
         with self._lock:
-            self._collectors.pop(name, None)
+            if fn is None or self._collectors.get(name) == fn:
+                self._collectors.pop(name, None)
 
     def get(self, name: str) -> _Metric | None:
         with self._lock:

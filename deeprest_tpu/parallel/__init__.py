@@ -1,6 +1,18 @@
 """Device-mesh construction, GSPMD sharding rules, and multi-host bring-up."""
 
-from deeprest_tpu.parallel.mesh import make_mesh
+from deeprest_tpu.parallel.mesh import (
+    NoValidMeshError,
+    make_mesh,
+    mesh_config_of,
+    shrink_mesh_config,
+)
+from deeprest_tpu.parallel.elastic import (
+    DeviceLossError,
+    FaultInjector,
+    RemeshExhaustedError,
+    enumerate_healthy,
+    is_device_loss,
+)
 from deeprest_tpu.parallel.sharding import (
     PARTITION_RULES,
     batch_sharding,
@@ -23,6 +35,14 @@ from deeprest_tpu.parallel.distributed import (
 
 __all__ = [
     "make_mesh",
+    "mesh_config_of",
+    "shrink_mesh_config",
+    "NoValidMeshError",
+    "DeviceLossError",
+    "FaultInjector",
+    "RemeshExhaustedError",
+    "enumerate_healthy",
+    "is_device_loss",
     "PARTITION_RULES",
     "match_partition_rules",
     "state_sharding",

@@ -1,0 +1,191 @@
+"""Elastic remeshing primitives: device-loss detection for the fault
+barrier (ROADMAP item 7's last training gap).
+
+A preempted multi-chip run used to die and restart the whole process on
+the surviving mesh (round 17's ``resume_training`` contract).  GSPMD's
+annotation model makes the in-process fix natural — the rule table
+(`parallel/sharding.PARTITION_RULES`) already places every leaf on *any*
+mesh shape and the round-12 cross-mesh restore reassembles checkpoints
+by global index — so device loss becomes a caught exception and a
+re-dispatch, not a process death.  This module owns the DETECT leg:
+
+- :class:`DeviceLossError` — the typed synthetic loss the deterministic
+  :class:`FaultInjector` raises at step K on the CPU backend, making the
+  whole detect→rebuild→restore→resume path tier-1 testable without a
+  chip to actually lose;
+- :func:`is_device_loss` — classifies an exception as the device-loss
+  family: a :class:`DeviceLossError`, or a real ``XlaRuntimeError``
+  whose message carries the runtime's device-failure markers (slice
+  preemption, halted cores, ``UNAVAILABLE``/``ABORTED`` transport
+  states on a dead ICI neighbor);
+- :func:`enumerate_healthy` — the hardware re-enumeration probe: one
+  tiny ``device_put`` per candidate device, survivors in stable order.
+
+The REBUILD leg (shrink the data axis first, preserve expert/model)
+lives in :func:`parallel.mesh.shrink_mesh_config`; the RESTORE leg is
+the round-12 cross-mesh assembly in ``train/checkpoint.py``; the barrier
+composing them is ``Trainer._run_epochs_elastic`` (the ONLY sanctioned
+swallow point for this exception family — graftlint EX004 enforces
+that).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Mapping, Sequence
+
+__all__ = [
+    "DeviceLossError",
+    "RemeshExhaustedError",
+    "FaultInjector",
+    "is_device_loss",
+    "enumerate_healthy",
+    "xla_runtime_error_type",
+]
+
+
+class DeviceLossError(RuntimeError):
+    """Synthetic device loss (the :class:`FaultInjector`'s signal).
+
+    ``lost`` is how many devices the event takes down — the injector's
+    deterministic stand-in for the hardware re-enumeration a real
+    ``XlaRuntimeError`` triggers.
+    """
+
+    def __init__(self, message: str, lost: int = 1):
+        super().__init__(message)
+        self.lost = int(lost)
+
+
+class RemeshExhaustedError(RuntimeError):
+    """Device losses outran ``TrainConfig.remesh_max_attempts``: the
+    bounded barrier refuses to respin forever (the RS004 discipline,
+    applied to the training plane) and surfaces the final loss."""
+
+
+def xla_runtime_error_type() -> type | None:
+    """The running jaxlib's ``XlaRuntimeError`` class (None when the
+    probe paths all miss — an exotic jax build; the synthetic family
+    still classifies)."""
+    try:
+        import jax
+
+        t = getattr(getattr(jax, "errors", None), "JaxRuntimeError", None)
+        if isinstance(t, type):
+            return t
+    except Exception:
+        pass
+    try:
+        from jax._src.lib import xla_client
+
+        t = getattr(xla_client, "XlaRuntimeError", None)
+        if isinstance(t, type):
+            return t
+    except Exception:
+        pass
+    return None
+
+
+# Message markers of a LOST DEVICE inside an XlaRuntimeError.  Deliberately
+# conservative: a compile error or a shape mismatch also arrives as
+# XlaRuntimeError, and remeshing on those would loop a deterministic bug
+# through restore-retry until the attempt budget ran out.  These markers
+# are the TPU runtime's device-death vocabulary (slice preemption, halted
+# cores, dead-ICI transport states).
+_DEVICE_LOSS_RE = re.compile(
+    r"(?i)(device\s+(lost|fail|halt)|DEVICE_SHUTDOWN|slice.*(preempt|halt)"
+    r"|preempt(ed|ion)|UNAVAILABLE|ABORTED|DATA_LOSS"
+    r"|hardware\s+fail|core\s+halt)")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Is this exception the device-loss family the fault barrier owns?
+
+    True for the synthetic :class:`DeviceLossError` and for a real
+    ``XlaRuntimeError`` whose message matches the device-death markers.
+    Everything else — including other XlaRuntimeErrors (compile errors,
+    shape mismatches: deterministic bugs a remesh would merely replay) —
+    is NOT device loss and must propagate.
+    """
+    if isinstance(exc, DeviceLossError):
+        return True
+    xla_err = xla_runtime_error_type()
+    if xla_err is not None and isinstance(exc, xla_err):
+        return bool(_DEVICE_LOSS_RE.search(str(exc)))
+    return False
+
+
+def enumerate_healthy(devices: Sequence) -> list:
+    """Re-enumerate which of ``devices`` still accept work.
+
+    One scalar ``device_put`` + readback per candidate; survivors come
+    back in the input order (stable, so a rebuilt mesh keeps the
+    surviving prefix layout deterministic).  On the CPU backend every
+    virtual device always answers — synthetic losses are the
+    :class:`FaultInjector`'s job there.
+    """
+    import numpy as np
+
+    import jax
+
+    healthy = []
+    probe = np.zeros((), np.int32)
+    for dev in devices:
+        try:
+            jax.block_until_ready(jax.device_put(probe, dev))
+        except Exception:
+            # the probe failing IS the health verdict this function
+            # exists to produce; the dead device simply drops out
+            continue
+        healthy.append(dev)
+    return healthy
+
+
+class FaultInjector:
+    """Deterministic synthetic device loss at global step K.
+
+    ``lose_at`` maps GLOBAL train-step numbers to how many devices that
+    event takes down (dropped from the TAIL of the current device list,
+    so the surviving prefix matches what a fresh process would lay its
+    shrunk mesh over — the parity spec's requirement).  The trainer
+    calls :meth:`note_steps` after every train dispatch, BEFORE any
+    bookkeeping: a superstep whose chunk covers a scheduled step raises
+    mid-chunk semantics — nothing from that dispatch is committed, the
+    barrier restores the newest durable snapshot.
+
+    Each event fires exactly once (keyed by global step), so the
+    post-restore REPLAY of the same steps does not re-trigger it — the
+    device is already gone.
+    """
+
+    def __init__(self, lose_at: Mapping[int, int]):
+        self._lose_at = {int(k): int(v) for k, v in dict(lose_at).items()}
+        for step, n in self._lose_at.items():
+            if step < 1 or n < 1:
+                raise ValueError(
+                    f"FaultInjector lose_at[{step}]={n}: steps and "
+                    "device counts must be >= 1")
+        # devices lost by events not yet consumed by healthy()
+        self._pending_lost = 0
+        self.events: list[tuple[int, int]] = []
+
+    def note_steps(self, global_step_before: int, n: int) -> None:
+        """A dispatch just covered global steps (before, before+n]."""
+        lo, hi = int(global_step_before), int(global_step_before) + int(n)
+        hit = sorted(s for s in self._lose_at if lo < s <= hi)
+        if not hit:
+            return
+        lost = sum(self._lose_at.pop(s) for s in hit)
+        self._pending_lost += lost
+        self.events.append((hit[0], lost))
+        raise DeviceLossError(
+            f"synthetic device loss at step {hit[0]}: {lost} device(s) "
+            f"dropped (dispatch covered steps {lo + 1}..{hi})", lost=lost)
+
+    def healthy(self, devices: Sequence) -> list:
+        """The surviving subset of ``devices`` after pending loss events
+        (tail-dropped, order preserved); consuming resets the pending
+        count so sequential losses compose."""
+        lost, self._pending_lost = self._pending_lost, 0
+        keep = max(0, len(devices) - lost)
+        return list(devices)[:keep]

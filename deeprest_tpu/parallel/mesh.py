@@ -30,6 +30,55 @@ from deeprest_tpu.config import MeshConfig
 AXES = ("data", "expert", "model")
 
 
+class NoValidMeshError(RuntimeError):
+    """No mesh shape fits the surviving devices (elastic remeshing):
+    the expert/model axes are load-bearing — shrinking them would
+    re-partition parameters mid-run — so when ``expert * model`` devices
+    no longer exist there is nothing left to rebuild onto.  The caller
+    (the trainer's fault barrier) surfaces this typed error instead of
+    respinning."""
+
+
+def shrink_mesh_config(config: MeshConfig, healthy_count: int) -> MeshConfig:
+    """The largest valid mesh on ``healthy_count`` devices: shrink the
+    DATA axis first, preserve expert/model.
+
+    The data axis is the safe one to fold — batch rows redistribute and
+    the gradient all-reduce simply spans fewer shards — while the
+    expert/model axes encode the parameter partitioning the rule table
+    placed.  The new data extent is the largest **divisor** of the old
+    one that fits: divisor, not just ≤, so a batch size divisible by the
+    old data axis stays divisible by the new one (the
+    ``feed_global_batch`` contract survives the shrink — 8→4→2→1, never
+    8→7).  Raises :class:`NoValidMeshError` when even ``data=1`` does
+    not fit (fewer than ``expert * model`` healthy devices).
+    """
+    if healthy_count < 1:
+        raise NoValidMeshError(
+            f"no healthy devices remain (mesh was "
+            f"{config.data}x{config.expert}x{config.model})")
+    em = config.expert * config.model
+    if em > healthy_count:
+        raise NoValidMeshError(
+            f"only {healthy_count} healthy device(s) remain but the "
+            f"expert*model plane needs {em} "
+            f"({config.expert}x{config.model}); the expert/model axes "
+            "carry the parameter partitioning and cannot shrink in-run")
+    budget = healthy_count // em
+    d = next(d for d in range(min(config.data, budget), 0, -1)
+             if config.data % d == 0)
+    return MeshConfig(data=d, expert=config.expert, model=config.model)
+
+
+def mesh_config_of(mesh: Mesh) -> MeshConfig:
+    """The :class:`MeshConfig` a live mesh was (or could have been)
+    built from — the shrink computation's input when a trainer holds
+    only the constructed mesh."""
+    return MeshConfig(data=int(mesh.shape["data"]),
+                      expert=int(mesh.shape["expert"]),
+                      model=int(mesh.shape["model"]))
+
+
 def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build the (data, expert, model) mesh.
 

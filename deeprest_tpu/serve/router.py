@@ -913,6 +913,13 @@ class ReplicaRouter:
             self._autoscaler_decision = dict(decision)
 
     def close(self) -> None:
+        # Deregister the render-time collector FIRST: the process-wide
+        # registry would otherwise hold this router (and every replica
+        # stack's device-resident params) alive forever — the leak the
+        # chaos storm's device-buffer census caught.  Conditional on the
+        # bound method so a rebuilt plane's newer registration survives.
+        obs_metrics.REGISTRY.unregister_collector("router",
+                                                  self._collect_metrics)
         with self._lock:
             self._closed = True
             replicas = list(self._replicas)

@@ -282,6 +282,12 @@ class PredictionService:
         minimal test/protocol backends that implement only the read-side
         serving surface (``predict_series`` + metadata) and carry no
         batcher attachment point or replica plane."""
+        # Drop our render-time collector (conditionally — a rebuilt
+        # service re-registers the name): a registered bound method in
+        # the process-wide registry pins the closed service, its
+        # predictor stack, and the device buffers behind it forever.
+        obs_metrics.REGISTRY.unregister_collector("serving",
+                                                  self._collect_metrics)
         with self._lock:
             old, self.batcher = self.batcher, None
             self.batching = None

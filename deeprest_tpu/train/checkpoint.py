@@ -269,6 +269,18 @@ def load_sidecar(directory: str, step: int | None = None,
         raise
 
 
+def _has_full_cursor(extra: dict | None) -> bool:
+    """Does this sidecar carry a FULL epoch-plan cursor (a resume/remesh
+    anchor)?  Streaming snapshots carry a light cursor (epoch None — the
+    stream never plan-replays) and plain epoch-cadence checkpoints carry
+    none; both are excluded."""
+    if extra is None:
+        return False
+    cur = extra.get("train_cursor")
+    return (isinstance(cur, dict) and cur.get("epoch") is not None
+            and cur.get("rng_state") is not None)
+
+
 def latest_cursor_step(directory: str) -> int | None:
     """Newest checkpoint step whose sidecar carries a full epoch-plan
     ``train_cursor`` (written by the trainer's preemption snapshots) —
@@ -277,14 +289,43 @@ def latest_cursor_step(directory: str) -> int | None:
     checkpoints with the light cursor) are skipped, so a resumable
     snapshot behind a newer non-resumable save is still found."""
     for step in reversed(list_steps(directory)):
-        extra = load_sidecar(directory, step, missing_ok=True)
-        if extra is None:
-            continue
-        cur = extra.get("train_cursor")
-        if isinstance(cur, dict) and cur.get("epoch") is not None \
-                and cur.get("rng_state") is not None:
+        if _has_full_cursor(load_sidecar(directory, step, missing_ok=True)):
             return step
     return None
+
+
+def prune_cursor_snapshots(directory: str, keep: int) -> list[int]:
+    """Snapshot retention GC: delete all but the newest ``keep`` CURSOR
+    snapshots; returns the pruned step numbers.
+
+    Only cursor-bearing steps (the preemption/remesh restore anchors)
+    are candidates — epoch-cadence checkpoints and streaming refresh
+    checkpoints are other consumers' property and are never touched.
+    Called AFTER a durable newer save (the trainer's snapshot() orders
+    it so), which is what makes the retention safe against a concurrent
+    restore: the restore target is always among the newest ``keep``
+    (``keep >= 1``), so a restore that resolved ``latest_cursor_step``
+    before this prune ran reads a directory the prune does not touch.
+    The parent directory is fsync'd after the removals so the deletions
+    are as durable as the saves were.
+    """
+    import shutil
+
+    if keep < 1:
+        raise ValueError(f"prune_cursor_snapshots(keep={keep}): must be "
+                         ">= 1 (the newest snapshot is the restore "
+                         "target and must survive)")
+    cursor_steps = [
+        step for step in list_steps(directory)
+        if _has_full_cursor(load_sidecar(directory, step, missing_ok=True))
+    ]
+    pruned = []
+    for step in cursor_steps[:-keep]:
+        shutil.rmtree(_step_dir(directory, step), ignore_errors=True)
+        pruned.append(step)
+    if pruned:
+        _fsync_dir(os.path.abspath(directory))
+    return pruned
 
 
 def prune_checkpoints(directory: str, keep: int) -> list[int]:

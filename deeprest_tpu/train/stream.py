@@ -553,6 +553,12 @@ class StreamingTrainer:
                           self.config.train.delta_resources)
 
     def ready(self) -> bool:
+        if self.trainer is not None and self.trainer.remesh_in_flight:
+            # A remesh is rebuilding/restoring: refresh decisions are
+            # DEFERRED, never dropped — the pending count and any queued
+            # _force_refresh trigger survive untouched and fire at the
+            # next readiness check.
+            return False
         w = self.config.train.window_size
         min_windows = self.stream.eval_holdout + 2
         due = (self._pending >= self.stream.refresh_buckets
@@ -682,11 +688,49 @@ class StreamingTrainer:
         # is W× less transfer than shipping overlapping windows even for
         # a single epoch (re-staged each refresh — the series grew).
         staged = self.trainer.stage_dataset(bundle)
-        for _ in range(self.stream.finetune_epochs):
-            self.state, train_loss = self.trainer.train_epoch(
-                self.state, bundle, data_rng, staged=staged)
-        eval_loss, _ = self.trainer.evaluate(self.state, bundle,
-                                             staged=staged)
+        # The stream joins the trainer's elastic fault barrier
+        # (TrainConfig.elastic): a device loss mid-fine-tune remeshes,
+        # restores the newest durable checkpoint (a mid-refresh snapshot
+        # or the last refresh-end save), and re-runs the interrupted
+        # epoch — the refresh is DEFERRED through the remesh, never
+        # dropped, and a DriftController trigger queued meanwhile stays
+        # queued (self._force_refresh survives untouched).  The stream
+        # deliberately does not plan-replay the interrupted fine-tune
+        # (see _wire_snapshots); bounded attempts + backoff are the
+        # trainer's knobs.
+        from deeprest_tpu.parallel.elastic import (
+            RemeshExhaustedError, is_device_loss,
+        )
+
+        elastic = self.config.train.elastic
+        epochs_done = 0
+        attempts = 0
+        while True:
+            reason = None
+            try:
+                while epochs_done < self.stream.finetune_epochs:
+                    self.state, train_loss = self.trainer.train_epoch(
+                        self.state, bundle, data_rng, staged=staged)
+                    epochs_done += 1
+                eval_loss, _ = self.trainer.evaluate(self.state, bundle,
+                                                     staged=staged)
+                break
+            except Exception as exc:
+                if not elastic or not is_device_loss(exc):
+                    raise
+                attempts += 1
+                if attempts > self.config.train.remesh_max_attempts:
+                    raise RemeshExhaustedError(
+                        f"device loss #{attempts} mid-refresh exceeds "
+                        "remesh_max_attempts="
+                        f"{self.config.train.remesh_max_attempts}"
+                    ) from exc
+                reason = f"{type(exc).__name__}: {exc}"
+            # Recovery outside the except block (the traceback pins the
+            # failed epoch's old-mesh buffers — same discipline as
+            # Trainer._run_epochs_elastic).
+            staged = None
+            staged = self._handle_device_loss(bundle, attempts, reason)
 
         path = None
         self._pending = 0
@@ -752,6 +796,58 @@ class StreamingTrainer:
         if n and self.ckpt_dir and self.trainer is not None:
             self.trainer.enable_snapshots(self.ckpt_dir, n,
                                           extra_fn=self._snapshot_extra)
+
+    def _handle_device_loss(self, bundle: DatasetBundle, attempt: int,
+                            reason: str):
+        """The stream's leg of the elastic fault barrier: remesh the
+        embedded trainer onto the survivors, restore the newest durable
+        checkpoint (mid-refresh snapshot or refresh-end save — both
+        carry the full stream sidecar), and re-stage the refresh bundle
+        onto the new mesh.  Returns the fresh ``staged`` feed.  The
+        restored params are at most ``snapshot_every_steps`` stale; the
+        interrupted fine-tune epoch re-runs from them (the stream never
+        plan-replays — its refresh re-trains the retained corpus every
+        cycle anyway)."""
+        from deeprest_tpu.train.checkpoint import (
+            list_steps, load_sidecar, restore_checkpoint,
+        )
+
+        tr = self.trainer
+        sw = obs_metrics.Stopwatch()
+        tr._remesh_in_flight = True
+        try:
+            tr._m_device_losses.inc()
+            tr.remesh(attempt=attempt, reason=reason)
+            state = step = None
+            if self.ckpt_dir:
+                for cand in reversed(list_steps(self.ckpt_dir)):
+                    if load_sidecar(self.ckpt_dir, cand,
+                                    missing_ok=True) is not None:
+                        step = cand
+                        break
+            if step is not None:
+                template = tr.init_state(tr.sample_input(bundle))
+                state, _ = restore_checkpoint(self.ckpt_dir, template,
+                                              step=step)
+            if state is None:
+                # lost before anything durable existed: re-init on the
+                # new mesh, like a restarted stream process would
+                state = tr.init_state(tr.sample_input(bundle))
+            self.state = state
+            recovery_s = sw.elapsed()
+            tr.remesh_count += 1
+            tr.last_remesh = {
+                "attempt": attempt, "restored_step": step,
+                "mesh": {a: int(tr.mesh.shape[a])
+                         for a in ("data", "expert", "model")},
+                "recovery_s": recovery_s,
+            }
+            tr.remesh_history.append(tr.last_remesh)
+            tr._m_recovery.set(recovery_s)
+            tr._m_remeshes.inc(outcome="ok")
+            return tr.stage_dataset(bundle)
+        finally:
+            tr._remesh_in_flight = False
 
     # -- resume ---------------------------------------------------------
 

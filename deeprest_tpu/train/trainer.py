@@ -28,13 +28,19 @@ import optax
 from deeprest_tpu.config import Config
 from deeprest_tpu.models.qrnn import QuantileGRU, fold_feature_mask
 from deeprest_tpu.obs import metrics as obs_metrics
+from deeprest_tpu.obs import spans as obs_spans
 from deeprest_tpu.ops.densify import SparseBase, gather_densify_normalize
 from deeprest_tpu.ops.quantile import pinball_loss
 from deeprest_tpu.parallel.distributed import (
     feed_replicated, gather_to_host, prefetch_to_device, stage_plan,
     stage_sparse_base,
 )
-from deeprest_tpu.parallel.mesh import make_mesh
+from deeprest_tpu.parallel.elastic import (
+    FaultInjector, RemeshExhaustedError, enumerate_healthy, is_device_loss,
+)
+from deeprest_tpu.parallel.mesh import (
+    NoValidMeshError, make_mesh, mesh_config_of, shrink_mesh_config,
+)
 from deeprest_tpu.parallel.sharding import shard_params, state_sharding
 from deeprest_tpu.train.data import DatasetBundle, eval_window_indices
 from deeprest_tpu.train.metrics import Throughput, mae_report
@@ -90,7 +96,33 @@ class Trainer:
         self._cursor_rng_state: dict | None = None
         self._epoch_steps_done = 0
         self._epoch_num_steps = 0
+        # Elastic remeshing (TrainConfig.elastic): the deterministic CPU
+        # fault injector (None on hardware — real XlaRuntimeErrors are
+        # the detect signal there), the in-flight flag the streaming
+        # trainer defers refresh decisions on, and the per-fit remesh
+        # ledger (attempt count + the last recovery's facts, which the
+        # chaos bench and tests read).
+        self._fault_injector: FaultInjector | None = None
+        self._remesh_in_flight = False
+        self.remesh_count = 0
+        self.last_remesh: dict | None = None
+        self.remesh_history: list[dict] = []
+        self._build_programs()
+        self._build_metrics()
 
+    def _build_programs(self) -> None:
+        """(Re)build every jitted program against the CURRENT mesh.
+
+        Called from ``__init__`` and again by :meth:`remesh`: the
+        programs close over ``self.mesh`` through ``pin_state``'s
+        rule-table constraint, and a cached jit wrapper pins its device
+        set — dispatching new-mesh arguments into an old-mesh wrapper is
+        an "incompatible devices" error, not a retrace.  Rebuilding the
+        wrappers keeps the executable story flat: each wrapper holds one
+        executable per signature ON THE CURRENT SHAPE (the chaos bench's
+        flatness gate), and XLA's persistent compilation cache absorbs
+        any recurring shape.
+        """
         quantiles = self.model_config.quantiles
 
         def pin_state(state: TrainState) -> TrainState:
@@ -234,8 +266,8 @@ class Trainer:
         # fold_in(rng, step)-then-fold_in(·, g) — a stream of its own
         # (grad accumulation is a different training algorithm; it is
         # pinned against its OWN loop reference, not against G=1).
-        accum_g = int(config.train.grad_accum_windows)
-        accum_mode = config.train.grad_accum_mode
+        accum_g = int(self.config.train.grad_accum_windows)
+        accum_mode = self.config.train.grad_accum_mode
 
         def _gather_windows(x_base, y_base, starts):
             w = self.config.train.window_size
@@ -375,6 +407,8 @@ class Trainer:
                 {"params": params}, xb, deterministic=True
             )
         )
+
+    def _build_metrics(self) -> None:
         # Training-plane obs metrics (process-wide registry singletons —
         # step time itself rides in via Throughput.stop): superstep
         # dispatch counts, the designed host-readback counter, and the
@@ -394,6 +428,23 @@ class Trainer:
         self._m_snapshots = obs_metrics.REGISTRY.counter(
             "deeprest_train_snapshots_total",
             "preemption-safe cursor snapshots written")
+        # Elastic-remeshing legs (detect -> rebuild -> restore -> resume),
+        # one increment per event — never on the step path.
+        self._m_device_losses = obs_metrics.REGISTRY.counter(
+            "deeprest_train_device_losses_total",
+            "device-loss events caught by the elastic fault barrier")
+        self._m_remeshes = obs_metrics.REGISTRY.counter(
+            "deeprest_train_remeshes_total",
+            "elastic remesh outcomes", labelnames=("outcome",))
+        self._m_mesh_devices = obs_metrics.REGISTRY.gauge(
+            "deeprest_train_mesh_devices",
+            "devices in the trainer's current mesh")
+        self._m_recovery = obs_metrics.REGISTRY.gauge(
+            "deeprest_train_remesh_recovery_seconds",
+            "wall seconds of the last remesh recovery "
+            "(detect through restore; the first post-restore dispatch "
+            "additionally pays one compile per new mesh shape)")
+        self._m_mesh_devices.set(self.mesh.devices.size)
 
     def _jit_cache_size(self) -> int | None:
         """Total compiled-executable count across the trainer's jitted
@@ -487,7 +538,196 @@ class Trainer:
                          extra_host_state=extra)
         self._snapshots_written += 1
         self._m_snapshots.inc()
+        # Retention GC AFTER the durable save: only cursor snapshots are
+        # candidates and the newest `snapshot_keep` always survive, so
+        # the restore target of any concurrent resume/remesh is never
+        # pruned (train/checkpoint.prune_cursor_snapshots).
+        keep = self.config.train.snapshot_keep
+        if keep:
+            from deeprest_tpu.train.checkpoint import prune_cursor_snapshots
+
+            prune_cursor_snapshots(self._snapshot_dir, keep)
         return path
+
+    # -- elastic remeshing (ROADMAP item 7, the last training gap) -----
+
+    def install_fault_injector(self, injector: FaultInjector) -> None:
+        """Arm the deterministic synthetic device-loss injector (CPU
+        testability for the whole detect→rebuild→restore→resume path;
+        on hardware the detect signal is the real ``XlaRuntimeError``
+        and no injector is installed)."""
+        self._fault_injector = injector
+
+    def _fault_check(self, n: int) -> None:
+        """Probe the injector right after a train dispatch covering the
+        next ``n`` global steps — before any cursor/snapshot/logging
+        bookkeeping, so a raised loss rolls back to the newest durable
+        snapshot exactly like a dispatch that failed on hardware."""
+        if self._fault_injector is not None:
+            self._fault_injector.note_steps(self._global_step, n)
+
+    @property
+    def remesh_in_flight(self) -> bool:
+        """True while the fault barrier is rebuilding/restoring — the
+        streaming trainer defers refresh decisions (never drops them)
+        while this holds."""
+        return self._remesh_in_flight
+
+    def remesh(self, attempt: int = 1, reason: str = "") -> int:
+        """The DETECT + REBUILD legs: re-enumerate healthy devices,
+        shrink the mesh (data axis first, expert/model preserved —
+        :func:`parallel.mesh.shrink_mesh_config`), and swap
+        ``self.mesh`` in place.  Every jitted program re-derives its
+        shardings from the one rule table at the first new-mesh trace,
+        so the jit caches stay at one executable per program per
+        DISTINCT mesh shape — old-shape executables remain cached, new
+        shapes compile once.  Returns the healthy-device count; raises
+        :class:`NoValidMeshError` (typed, counted) when fewer than
+        ``expert * model`` devices survive."""
+        import time
+
+        with obs_spans.RECORDER.span("elastic.detect",
+                                     component="deeprest-elastic") as sp:
+            devices = list(self.mesh.devices.flat)
+            if self._fault_injector is not None:
+                healthy = self._fault_injector.healthy(devices)
+            else:
+                healthy = enumerate_healthy(devices)
+            sp.tag(attempt=attempt, reason=reason[:200],
+                   devices=len(devices), healthy=len(healthy))
+        backoff_s = self.config.train.remesh_backoff_ms / 1e3 * attempt
+        if backoff_s:
+            time.sleep(backoff_s)
+        with obs_spans.RECORDER.span("elastic.rebuild",
+                                     component="deeprest-elastic") as sp:
+            try:
+                cfg = shrink_mesh_config(mesh_config_of(self.mesh),
+                                         len(healthy))
+            except NoValidMeshError:
+                self._m_remeshes.inc(outcome="no_valid_mesh")
+                raise
+            self.mesh = make_mesh(cfg, devices=healthy)
+            # Shardings re-derive from the one rule table at the first
+            # new-mesh trace; the wrappers must be rebuilt because a
+            # cached jit pins its device set (dispatching new-mesh
+            # arguments into an old-mesh wrapper raises, it does not
+            # retrace).  One program set per live mesh shape.
+            self._build_programs()
+            self._m_mesh_devices.set(cfg.size)
+            sp.tag(mesh=f"{cfg.data}x{cfg.expert}x{cfg.model}")
+        return len(healthy)
+
+    def _handle_device_loss(self, bundle: DatasetBundle, directory: str,
+                            attempt: int, reason: str):
+        """The remesh handler the fault barrier routes every caught
+        device loss to: rebuild the mesh over the survivors, restore the
+        newest fsync'd cursor snapshot IN-PROCESS through the cross-mesh
+        assembly, and hand back the exact resume coordinates
+        ``resume_training`` would compute in a fresh process — the
+        post-remesh trajectory is the restart-resume trajectory, bit for
+        bit (tests/test_chaos.py pins it).
+
+        Returns ``(state, data_rng, start_epoch, skip_steps)``.
+        """
+        from deeprest_tpu.train.checkpoint import (
+            latest_cursor_step, restore_checkpoint,
+        )
+
+        sw = obs_metrics.Stopwatch()
+        self._remesh_in_flight = True
+        try:
+            self._m_device_losses.inc()
+            self.remesh(attempt=attempt, reason=reason)
+            with obs_spans.RECORDER.span(
+                    "elastic.restore", component="deeprest-elastic") as sp:
+                step = latest_cursor_step(directory)
+                template = self.init_state(self.sample_input(bundle))
+                if step is None:
+                    # Lost before the first durable snapshot: nothing to
+                    # restore — re-init on the new mesh, exactly what a
+                    # restarted process would be forced to do.
+                    state = template
+                    data_rng = np.random.default_rng(self.config.train.seed)
+                    start_epoch = skip_steps = 0
+                    self._global_step = 0
+                else:
+                    state, extra = restore_checkpoint(directory, template,
+                                                      step=step)
+                    cursor = extra["train_cursor"]
+                    self._global_step = int(cursor["global_step"])
+                    data_rng = np.random.default_rng(self.config.train.seed)
+                    data_rng.bit_generator.state = cursor["rng_state"]
+                    start_epoch = int(cursor["epoch"])
+                    skip_steps = int(cursor["steps_done"])
+                sp.tag(restored_step=step, epoch=start_epoch,
+                       skip_steps=skip_steps)
+            self._steps_since_snapshot = 0
+            recovery_s = sw.elapsed()
+            self.remesh_count += 1
+            self.last_remesh = {
+                "attempt": attempt,
+                "restored_step": step,
+                "mesh": {a: int(self.mesh.shape[a])
+                         for a in ("data", "expert", "model")},
+                "recovery_s": recovery_s,
+            }
+            self.remesh_history.append(self.last_remesh)
+            self._m_recovery.set(recovery_s)
+            self._m_remeshes.inc(outcome="ok")
+            with obs_spans.RECORDER.span(
+                    "elastic.resume", component="deeprest-elastic") as sp:
+                # The resume leg proper is the re-entered epoch driver
+                # (re-stage + first new-shape compile); this span marks
+                # the handoff so the recovery trace is complete.
+                sp.tag(global_step=self._global_step,
+                       recovery_s=round(recovery_s, 4))
+            return state, data_rng, start_epoch, skip_steps
+        finally:
+            self._remesh_in_flight = False
+
+    def _run_epochs_elastic(self, bundle, state, data_rng, start_epoch,
+                            skip_steps, baseline_preds, on_epoch,
+                            num_epochs, on_step):
+        """THE fault barrier (the only sanctioned swallow point for the
+        device-loss family — graftlint EX004 keeps it that way): run the
+        epochs; on device loss, remesh + restore in-process and
+        continue, bounded by ``remesh_max_attempts`` with per-attempt
+        backoff."""
+        cfg = self.config.train
+        directory = self._snapshot_dir or cfg.checkpoint_dir
+        if not directory or not cfg.snapshot_every_steps:
+            raise ValueError(
+                "TrainConfig.elastic=True requires cursor snapshots: set "
+                "checkpoint_dir and snapshot_every_steps >= 1 (the "
+                "remesh barrier restores from the newest one)")
+        attempts = 0
+        while True:
+            reason = None
+            try:
+                return self._run_epochs(bundle, state, data_rng,
+                                        start_epoch, skip_steps,
+                                        baseline_preds, on_epoch,
+                                        num_epochs, on_step)
+            except Exception as exc:
+                if not is_device_loss(exc):
+                    raise
+                attempts += 1
+                if attempts > cfg.remesh_max_attempts:
+                    self._m_remeshes.inc(outcome="exhausted")
+                    raise RemeshExhaustedError(
+                        f"device loss #{attempts} exceeds "
+                        f"remesh_max_attempts={cfg.remesh_max_attempts}; "
+                        "surfacing the failure instead of respinning"
+                    ) from exc
+                reason = f"{type(exc).__name__}: {exc}"
+            # Recovery runs OUTSIDE the except block: the exception's
+            # traceback pins the failed epoch driver's frame (its staged
+            # feed and old-mesh state) alive; leaving the handler first
+            # releases those buffers before the rebuild re-stages.
+            state = None
+            state, data_rng, start_epoch, skip_steps = \
+                self._handle_device_loss(bundle, directory, attempts,
+                                         reason)
 
     # ------------------------------------------------------------------
 
@@ -770,6 +1010,11 @@ class Trainer:
 
         for batch in batches:
             state, loss = run(state, *batch)
+            # Fault barrier probe BEFORE any bookkeeping: a device lost
+            # during this dispatch means the step never happened — the
+            # cursor must not advance past it and no snapshot may
+            # include it (the barrier restores the newest durable one).
+            self._fault_check(1)
             losses.append(loss)
             self._global_step += 1
             if not self._warmed:
@@ -851,6 +1096,11 @@ class Trainer:
             real = min(s, num_steps - c * s)
             state, losses_c = superstep(state, x_base, y_base,
                                         starts_d, weights_d, c)
+            # Mid-superstep (and mid-grad-accum-group) device loss: the
+            # whole chunk's dispatch is the unit that fails, so the probe
+            # sits before ANY of the chunk's bookkeeping — progress since
+            # the last durable snapshot is what the barrier rolls back.
+            self._fault_check(real)
             chunk_losses.append(losses_c)
             if not self._warmed:
                 # First-ever superstep pays the scan's trace+compile.
@@ -984,9 +1234,10 @@ class Trainer:
         if state is None:
             state = self.init_state(self.sample_input(bundle))
         data_rng = np.random.default_rng(self.config.train.seed)
-        return self._run_epochs(bundle, state, data_rng, 0, 0,
-                                baseline_preds, on_epoch, num_epochs,
-                                on_step)
+        run = (self._run_epochs_elastic if self.config.train.elastic
+               else self._run_epochs)
+        return run(bundle, state, data_rng, 0, 0,
+                   baseline_preds, on_epoch, num_epochs, on_step)
 
     def resume_training(
         self,
@@ -1034,11 +1285,11 @@ class Trainer:
         self._global_step = int(cursor["global_step"])
         data_rng = np.random.default_rng(cfg.seed)
         data_rng.bit_generator.state = cursor["rng_state"]
-        return self._run_epochs(bundle, state, data_rng,
-                                int(cursor["epoch"]),
-                                int(cursor["steps_done"]),
-                                baseline_preds, on_epoch, num_epochs,
-                                on_step)
+        run = (self._run_epochs_elastic if cfg.elastic
+               else self._run_epochs)
+        return run(bundle, state, data_rng,
+                   int(cursor["epoch"]), int(cursor["steps_done"]),
+                   baseline_preds, on_epoch, num_epochs, on_step)
 
     def _run_epochs(
         self,

@@ -1055,7 +1055,7 @@ def test_rule_registry_complete():
             "TH001", "TH002", "TH003", "TH004",
             "HY001", "HY002", "OB001", "DN001", "DN002",
             "RS001", "RS002", "RS003", "RS004",
-            "EX001", "EX002", "EX003"} <= set(rules)
+            "EX001", "EX002", "EX003", "EX004"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards
 
@@ -1727,6 +1727,85 @@ def shutdown(conn):
 
 def test_ex003_outside_watchlists_is_silent():
     assert not findings_for("EX003", EX003_BAD, rel="loadgen/cluster.py")
+
+
+# ---------------------------------------------------------------------------
+# EX004: device-loss family swallowed outside the elastic fault barrier
+
+
+EX004_BAD = """
+def run_epoch(trainer, state, batch):
+    try:
+        state, loss = trainer._train_step(state, batch)
+    except XlaRuntimeError:
+        state = None
+    return state
+"""
+
+EX004_GOOD = """
+def run_epoch(trainer, state, batch, bundle):
+    try:
+        state, loss = trainer._train_step(state, batch)
+    except XlaRuntimeError as exc:
+        if not is_device_loss(exc):
+            raise
+        state = trainer._handle_device_loss(bundle)
+    return state
+"""
+
+
+def test_ex004_pair():
+    assert_pair("EX004", EX004_BAD, EX004_GOOD, rel="train/trainer.py")
+    assert_pair("EX004", EX004_BAD, EX004_GOOD, rel="parallel/elastic.py")
+
+
+def test_ex004_broad_except_around_dispatch_fires():
+    # a broad except is the family exactly when it wraps a dispatch —
+    # the shape the ONE fault barrier owns
+    src = """
+def drive(superstep, state, plan):
+    for c in range(8):
+        try:
+            state, losses = superstep(state, plan, c)
+        except Exception as exc:
+            print("oops", exc)
+    return state
+"""
+    fired = findings_for("EX004", src, rel="train/trainer.py")
+    assert fired and "barrier" in fired[0].message
+
+
+def test_ex004_broad_except_without_dispatch_is_silent():
+    # broad excepts around non-dispatch work (file IO, probes) are
+    # EX003's turf, not the device-loss family
+    src = """
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        return None
+"""
+    assert not findings_for("EX004", src, rel="train/checkpoint.py")
+
+
+def test_ex004_reraising_barrier_is_silent():
+    # the real barrier's shape: classify, re-raise what it does not own
+    src = """
+def barrier(run, bundle):
+    try:
+        return run(bundle)
+    except Exception as exc:
+        if not is_device_loss(exc):
+            raise
+        return remesh_and_restore(bundle)
+"""
+    assert not findings_for("EX004", src, rel="train/trainer.py")
+
+
+def test_ex004_outside_watchlist_is_silent():
+    assert not findings_for("EX004", EX004_BAD, rel="serve/replica.py")
+    assert not findings_for("EX004", EX004_BAD, rel="loadgen/cluster.py")
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,10 @@ from deeprest_tpu.config import (
     Config, FeaturizeConfig, MeshConfig, ModelConfig, TrainConfig,
 )
 from deeprest_tpu.data.featurize import featurize_buckets
-from deeprest_tpu.parallel.mesh import make_mesh
+from deeprest_tpu.parallel import (
+    DeviceLossError, FaultInjector, NoValidMeshError, RemeshExhaustedError,
+)
+from deeprest_tpu.parallel.mesh import make_mesh, shrink_mesh_config
 from deeprest_tpu.serve import ReplicaDeadError, ReplicaRouter, RouterConfig
 from deeprest_tpu.serve.replica import ProcessReplica
 from deeprest_tpu.serve.server import ServingError
@@ -66,7 +69,11 @@ class _SimulatedPreemption(BaseException):
 
 
 def _tiny_config(ckpt_dir, snapshot_every=2, superstep=2, accum=1,
-                 epochs=2):
+                 epochs=2, snapshot_keep=0, **train_kw):
+    # snapshot_keep=0 (unlimited) by default: the resume-parity matrix
+    # restores HISTORICAL steps (e.g. the kill-time snapshot from the
+    # uninterrupted twin), which the retention GC would otherwise prune;
+    # the GC has its own pinned tests below.
     return Config(
         model=ModelConfig(hidden_size=8, dropout_rate=0.5),
         train=TrainConfig(
@@ -75,7 +82,8 @@ def _tiny_config(ckpt_dir, snapshot_every=2, superstep=2, accum=1,
             device_data="always", steps_per_superstep=superstep,
             grad_accum_windows=accum, log_every_steps=0,
             checkpoint_dir=str(ckpt_dir),
-            snapshot_every_steps=snapshot_every))
+            snapshot_every_steps=snapshot_every,
+            snapshot_keep=snapshot_keep, **train_kw))
 
 
 @pytest.fixture(scope="module")
@@ -250,6 +258,299 @@ def test_resume_without_snapshot_raises(corpus, tmp_path):
     tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
     with pytest.raises(FileNotFoundError, match="cursor"):
         tr.resume_training(bundle)
+
+
+# ---------------------------------------------------------------------------
+# elastic remeshing: survive device loss IN-PROCESS (round 20)
+#
+# The parity spec: the post-remesh trajectory must be BIT-IDENTICAL to
+# the round-17 kill-process-and-resume_training-on-the-survivor-mesh
+# path at the same snapshot (same rng cursor, same skip-forward).  The
+# reference below uses the SAME FaultInjector without the elastic
+# barrier — the loss raises before any cursor bookkeeping, exactly the
+# crash a real device loss is — and a fresh trainer on the shrunk mesh
+# resumes, so both paths restore the same newest durable snapshot.
+
+
+def _run_elastic_vs_restart_resume(corpus, tmp_path, *, superstep, accum,
+                                   losses):
+    cfg_ref = _tiny_config(tmp_path / "ref", superstep=superstep,
+                           accum=accum)
+    bundle = prepare_dataset(corpus, cfg_ref.train)
+    schedule = sorted(losses.items())
+
+    # the round-17 restart-resume reference chain: one "process" per loss
+    data_axis = 8
+    state_ref = hist_ref = None
+    kill_anchors = []          # latest cursor step AT each kill instant
+    for i in range(len(schedule) + 1):
+        tr = Trainer(cfg_ref, bundle.feature_dim, bundle.metric_names,
+                     mesh=make_mesh(MeshConfig(data=data_axis)))
+        if i < len(schedule):
+            tr.install_fault_injector(FaultInjector(dict([schedule[i]])))
+        try:
+            if i == 0:
+                state_ref, hist_ref = tr.fit(bundle)
+            else:
+                state_ref, hist_ref = tr.resume_training(bundle)
+            break
+        except DeviceLossError:
+            kill_anchors.append(latest_cursor_step(str(tmp_path / "ref")))
+            data_axis = shrink_mesh_config(
+                MeshConfig(data=data_axis),
+                data_axis - schedule[i][1]).data
+
+    # elastic: ONE trainer, same schedule, recovery in-process
+    cfg_e = _tiny_config(tmp_path / "e", superstep=superstep, accum=accum,
+                         elastic=True, remesh_backoff_ms=1.0)
+    tr_e = Trainer(cfg_e, bundle.feature_dim, bundle.metric_names,
+                   mesh=make_mesh(MeshConfig(data=8)))
+    tr_e.install_fault_injector(FaultInjector(dict(schedule)))
+    state_e, hist_e = tr_e.fit(bundle)
+    # both paths restored from the SAME durable anchor at every loss
+    assert [r["restored_step"] for r in tr_e.remesh_history] \
+        == kill_anchors
+    return state_ref, hist_ref, tr_e, state_e, hist_e
+
+
+@pytest.mark.parametrize("superstep,accum",
+                         [(1, 1), (2, 1), (2, 2)],
+                         ids=["per-step", "mid-superstep",
+                              "mid-grad-accum"])
+def test_elastic_remesh_bit_identical_to_restart_resume(
+        corpus, tmp_path, superstep, accum):
+    """Kill 4 of 8 devices at step 3 (per-step dispatch, mid-superstep,
+    and mid-coalesced-group): the in-process remesh continues
+    bit-identical to the kill-and-resume_training reference on the same
+    survivor mesh, restoring the same snapshot."""
+    state_ref, hist_ref, tr_e, state_e, hist_e = \
+        _run_elastic_vs_restart_resume(
+            corpus, tmp_path, superstep=superstep, accum=accum,
+            losses={3: 4})
+    _assert_bit_identical(state_ref, state_e)
+    assert hist_ref[-1].test_loss == hist_e[-1].test_loss
+    assert tr_e.remesh_count == 1
+    assert tr_e.last_remesh["mesh"] == {"data": 4, "expert": 1,
+                                        "model": 1}
+    # obs: the recovery legs were measured
+    assert tr_e.last_remesh["recovery_s"] > 0
+
+
+def test_elastic_double_loss_shrinks_twice(corpus, tmp_path):
+    """Two losses in one run (8 -> 4 -> 2), the second mid-epoch-1:
+    still bit-identical to the twice-restarted reference chain."""
+    state_ref, hist_ref, tr_e, state_e, hist_e = \
+        _run_elastic_vs_restart_resume(
+            corpus, tmp_path, superstep=2, accum=1, losses={3: 4, 7: 2})
+    _assert_bit_identical(state_ref, state_e)
+    assert hist_ref[-1].test_loss == hist_e[-1].test_loss
+    assert tr_e.remesh_count == 2
+    assert [r["mesh"]["data"] for r in tr_e.remesh_history] == [4, 2]
+
+
+def test_elastic_attempt_budget_is_bounded(corpus, tmp_path):
+    """More losses than remesh_max_attempts surfaces the typed
+    RemeshExhaustedError (chaining the device loss) instead of
+    respinning forever."""
+    cfg = _tiny_config(tmp_path, elastic=True, remesh_backoff_ms=1.0,
+                       remesh_max_attempts=1)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names,
+                 mesh=make_mesh(MeshConfig(data=8)))
+    tr.install_fault_injector(FaultInjector({2: 2, 5: 2}))
+    with pytest.raises(RemeshExhaustedError) as exc:
+        tr.fit(bundle)
+    assert isinstance(exc.value.__cause__, DeviceLossError)
+    assert tr.remesh_count == 1          # the budgeted recovery happened
+
+
+def test_elastic_no_valid_mesh_is_typed(corpus, tmp_path):
+    """Losing below expert*model devices cannot rebuild (the expert/
+    model axes carry the parameter partitioning): NoValidMeshError, not
+    a respin, not a silent shrink of the wrong axis."""
+    cfg = _tiny_config(tmp_path, elastic=True, remesh_backoff_ms=1.0)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names,
+                 mesh=make_mesh(MeshConfig(data=4, expert=2)))
+    tr.install_fault_injector(FaultInjector({2: 7}))
+    with pytest.raises(NoValidMeshError, match="expert"):
+        tr.fit(bundle)
+
+
+def test_elastic_requires_snapshots():
+    """The config refuses elastic without a snapshot cadence (nothing to
+    restore from), and fit refuses it without a checkpoint_dir."""
+    with pytest.raises(ValueError, match="elastic"):
+        TrainConfig(elastic=True)                # no snapshot cadence
+    cfg = TrainConfig(elastic=True, snapshot_every_steps=2)
+    assert cfg.elastic                           # cadence alone is valid
+
+
+def test_elastic_fit_requires_checkpoint_dir(corpus):
+    cfg = Config(
+        model=ModelConfig(hidden_size=8, dropout_rate=0.5),
+        train=TrainConfig(num_epochs=1, batch_size=16, window_size=12,
+                          eval_stride=12, eval_max_cycles=2,
+                          device_data="always", log_every_steps=0,
+                          elastic=True, snapshot_every_steps=2))
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        tr.fit(bundle)
+
+
+def test_elastic_loss_before_first_snapshot_restarts_in_process(
+        corpus, tmp_path):
+    """A loss before anything durable exists re-inits on the shrunk mesh
+    (what a restarted process would be forced to do) and completes."""
+    cfg = _tiny_config(tmp_path, snapshot_every=100, elastic=True,
+                       remesh_backoff_ms=1.0)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names,
+                 mesh=make_mesh(MeshConfig(data=8)))
+    tr.install_fault_injector(FaultInjector({1: 4}))
+    state, hist = tr.fit(bundle)
+    assert tr.remesh_count == 1
+    assert tr.last_remesh["restored_step"] is None
+    assert all(np.isfinite(h.train_loss) for h in hist)
+    # the full run happened on the shrunk mesh from step 0
+    assert int(np.asarray(state.step)) == 8
+
+
+def test_stream_elastic_remesh_defers_refresh(tmp_path):
+    """The StreamingTrainer joins the same barrier: a device loss
+    mid-fine-tune remeshes + restores, the interrupted refresh DEFERS
+    through it and completes (never dropped), and a DriftController-
+    style queued trigger survives the remesh."""
+    from deeprest_tpu.train.stream import StreamConfig, StreamingTrainer
+    from deeprest_tpu.data.schema import Bucket, MetricSample
+
+    cfg = Config(
+        model=ModelConfig(feature_dim=32, hidden_size=8,
+                          dropout_rate=0.0),
+        train=TrainConfig(batch_size=8, window_size=6, seed=0,
+                          eval_stride=1, eval_max_cycles=2,
+                          log_every_steps=0, snapshot_every_steps=2,
+                          steps_per_superstep=1, device_data="always",
+                          elastic=True, remesh_backoff_ms=1.0),
+        mesh=MeshConfig(data=8))
+    st = StreamingTrainer(
+        cfg, StreamConfig(refresh_buckets=30, finetune_epochs=1,
+                          history_max=64, eval_holdout=4),
+        ckpt_dir=str(tmp_path),
+        feature_config=FeaturizeConfig(hash_features=True, capacity=32))
+    rng = np.random.default_rng(0)
+
+    def feed(n):
+        for _ in range(n):
+            st.ingest(Bucket(traces=[], metrics=[
+                MetricSample("svc", "cpu", float(rng.random()))]))
+
+    feed(40)
+    r1 = st.refresh()
+    assert dict(st.trainer.mesh.shape)["data"] == 8
+    # queue an out-of-cadence trigger, then lose half the mesh during
+    # the refresh it fires
+    st.request_refresh("manual")
+    st.trainer.install_fault_injector(
+        FaultInjector({st.trainer._global_step + 2: 4}))
+    feed(40)
+    assert st.ready()
+    r2 = st.refresh()
+    assert r2.trigger == "manual"        # the queued trigger survived
+    assert r2.refresh == r1.refresh + 1  # the refresh completed
+    assert st.trainer.remesh_count == 1
+    assert dict(st.trainer.mesh.shape)["data"] == 4
+    assert np.isfinite(r2.eval_loss)
+    assert not st.trainer.remesh_in_flight
+
+
+# ---------------------------------------------------------------------------
+# snapshot retention GC (snapshot_keep)
+
+
+def test_snapshot_retention_gc_bounds_cursor_snapshots(corpus, tmp_path):
+    """snapshot_every_steps used to accumulate checkpoints unboundedly;
+    snapshot_keep prunes the oldest cursor snapshots after each durable
+    newer save, never the restore target."""
+    cfg = _tiny_config(tmp_path, snapshot_every=1, snapshot_keep=2)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    state, _ = tr.fit(bundle)
+    from deeprest_tpu.train.checkpoint import _has_full_cursor, load_sidecar
+
+    cursor_steps = [s for s in list_steps(str(tmp_path))
+                    if _has_full_cursor(load_sidecar(str(tmp_path), s,
+                                                     missing_ok=True))]
+    assert len(cursor_steps) == 2        # pinned: exactly keep survive
+    assert latest_cursor_step(str(tmp_path)) == max(cursor_steps)
+    # the retained newest restores fine
+    template = tr.init_state(tr.sample_input(bundle))
+    restored, extra = restore_checkpoint(str(tmp_path), template,
+                                         step=max(cursor_steps))
+    assert extra["train_cursor"]["global_step"] == max(cursor_steps)
+
+
+def test_snapshot_gc_spares_non_cursor_checkpoints(corpus, tmp_path):
+    """Epoch-cadence / refresh checkpoints (no full cursor) are other
+    consumers' property: the GC never touches them, however old."""
+    from deeprest_tpu.train.checkpoint import prune_cursor_snapshots
+
+    cfg = _tiny_config(tmp_path / "gc", snapshot_every=0)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    state = tr.init_state(tr.sample_input(bundle))
+    # an OLD plain checkpoint (no cursor), then newer cursor snapshots
+    save_checkpoint(str(tmp_path / "gc"), state, 1, {"plain": True})
+    for step in (5, 6, 7):
+        save_checkpoint(
+            str(tmp_path / "gc"), state, step,
+            {"train_cursor": {"epoch": 0, "steps_done": step,
+                              "rng_state": {"state": step},
+                              "global_step": step}})
+    pruned = prune_cursor_snapshots(str(tmp_path / "gc"), keep=1)
+    assert pruned == [5, 6]
+    assert list_steps(str(tmp_path / "gc")) == [1, 7]
+
+
+def test_snapshot_gc_never_races_a_concurrent_restore(corpus, tmp_path):
+    """Pruning only ever deletes steps BELOW the newest `keep`, so a
+    restore of the current target proceeds untouched while the GC runs;
+    and keep < 1 is refused outright."""
+    from deeprest_tpu.train.checkpoint import prune_cursor_snapshots
+
+    cfg = _tiny_config(tmp_path, snapshot_every=1, snapshot_keep=0)
+    bundle = prepare_dataset(corpus, cfg.train)
+    tr = Trainer(cfg, bundle.feature_dim, bundle.metric_names)
+    tr.fit(bundle)
+    target = latest_cursor_step(str(tmp_path))
+    template = tr.init_state(tr.sample_input(bundle))
+    results = {}
+
+    def restore_loop():
+        out, _ = restore_checkpoint(str(tmp_path), template, step=target)
+        results["state"] = out
+
+    t = threading.Thread(target=restore_loop)
+    t.start()
+    prune_cursor_snapshots(str(tmp_path), keep=1)
+    t.join(timeout=120)
+    assert not t.is_alive() and "state" in results
+    assert latest_cursor_step(str(tmp_path)) == target
+    with pytest.raises(ValueError, match=">= 1"):
+        prune_cursor_snapshots(str(tmp_path), keep=0)
+
+
+def test_elastic_cli_help_covers_flags(capsys):
+    from deeprest_tpu.cli import build_parser
+
+    for sub in ("train", "stream"):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args([sub, "--help"])
+        out = capsys.readouterr().out
+        for flag in ("--elastic", "--remesh-max-attempts",
+                     "--remesh-backoff-ms", "--snapshot-keep"):
+            assert flag in out, f"{sub} --help missing {flag}"
 
 
 # ---------------------------------------------------------------------------
@@ -640,13 +941,15 @@ def test_committed_chaos_bench_gates():
     """The committed benchmarks/chaos_bench.json is the acceptance
     evidence for the storm: zero wrong answers, errors only fast
     429/503, no request past its deadline envelope, automatic rejoin,
-    and a clean post-storm thread/process/fd census."""
+    a clean post-storm thread/process/fd/device-buffer census, and (v2)
+    the elastic arm's bit-identical-to-restart-resume remesh gates."""
     with open(os.path.join(REPO, "benchmarks", "chaos_bench.json"),
               encoding="utf-8") as f:
         committed = json.load(f)
-    assert committed["schema_version"] == 1
+    assert committed["schema_version"] == 2
     assert committed["pass"] is True
-    for arm_name, arm in committed["arms"].items():
+    for arm_name in ("thread", "process"):
+        arm = committed["arms"][arm_name]
         assert arm["wrong_answers"] == 0, arm_name
         assert arm["other_status"] == 0, arm_name
         assert arm["ok"] >= 1
@@ -654,6 +957,22 @@ def test_committed_chaos_bench_gates():
         assert arm["ejections"] >= 1 and arm["rejoins"] >= 1
         assert arm["recovery_s"] <= arm["recovery_envelope_s"]
         assert arm["leak"]["clean"] is True
+        # v2: the census sees device memory — a closed plane must free
+        # its replica stacks' buffers (the collector-pin leak this
+        # column caught on its first run)
+        assert (arm["leak"]["after"]["device_buffers"]
+                <= arm["leak"]["before"]["device_buffers"]), arm_name
+    elastic = committed["arms"]["elastic"]
+    assert elastic["pass"] is True
+    assert elastic["bit_identical"] is True
+    assert elastic["executables_flat"] is True
+    assert elastic["remeshes"] >= 3           # storms all three paths
+    assert elastic["max_recovery_s"] <= elastic["recovery_envelope_s"]
+    assert elastic["leak"]["clean"] is True
+    for cell_name, cell in elastic["scenarios"].items():
+        assert cell["remeshes"] == cell["expected_remeshes"], cell_name
+        assert cell["bit_identical"] is True, cell_name
+        assert cell["final_test_loss_equal"] is True, cell_name
 
 
 @pytest.mark.slow
@@ -675,6 +994,10 @@ def test_chaos_bench_quick_storm(tmp_path):
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["pass"] is True
     assert result["quick"] is True
-    for arm in result["arms"].values():
-        assert arm["wrong_answers"] == 0
-        assert arm["leak"]["clean"] is True
+    for name, arm in result["arms"].items():
+        assert arm["leak"]["clean"] is True, name
+        if name == "elastic":
+            assert arm["bit_identical"] is True
+            assert arm["executables_flat"] is True
+        else:
+            assert arm["wrong_answers"] == 0, name

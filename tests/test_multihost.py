@@ -92,3 +92,67 @@ def test_two_process_training_matches_single_process():
     train_solo, eval_solo = _parse(solo.stdout)
     np.testing.assert_allclose(train_multi, train_solo, rtol=2e-3)
     np.testing.assert_allclose(eval_multi, eval_solo, rtol=2e-3)
+
+
+def test_elastic_remesh_on_virtual_mesh_matches_restart_resume(tmp_path):
+    """The elastic path under the single-process 8-device virtual mesh —
+    the same coverage stand-in the pod paths get (cross-process
+    collectives are unavailable in this container; see the skip note
+    above).  An 8→4 device shrink mid-epoch-0 continues IN-PROCESS
+    bit-identical to the kill-process-and-resume_training reference on
+    the survivor mesh: the full detect→rebuild→restore→resume chain over
+    the exact multi-process assembly code (`feed_global_batch` /
+    `stage_plan` re-staged onto the shrunk mesh)."""
+    import jax
+
+    from deeprest_tpu.config import (
+        Config, FeaturizeConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from deeprest_tpu.data.featurize import featurize_buckets
+    from deeprest_tpu.parallel import DeviceLossError, FaultInjector
+    from deeprest_tpu.parallel.mesh import make_mesh
+    from deeprest_tpu.train import Trainer, prepare_dataset
+
+    from conftest import make_series_buckets
+
+    assert len(jax.devices()) >= 8, "conftest forces 8 virtual devices"
+
+    def cfg_for(d, elastic):
+        return Config(
+            model=ModelConfig(hidden_size=8, dropout_rate=0.5),
+            train=TrainConfig(
+                num_epochs=2, batch_size=16, window_size=12,
+                eval_stride=12, eval_max_cycles=2, seed=0,
+                device_data="always", steps_per_superstep=2,
+                log_every_steps=0, checkpoint_dir=str(d),
+                snapshot_every_steps=2, snapshot_keep=0,
+                elastic=elastic, remesh_backoff_ms=1.0))
+
+    corpus = featurize_buckets(make_series_buckets(140, seed=7),
+                               FeaturizeConfig(round_to=8))
+
+    # reference: crash at step 3 (4 of 8 devices lost), fresh trainer
+    # resumes on the 4-device survivor mesh
+    cfg_ref = cfg_for(tmp_path / "ref", elastic=False)
+    bundle = prepare_dataset(corpus, cfg_ref.train)
+    tr_a = Trainer(cfg_ref, bundle.feature_dim, bundle.metric_names,
+                   mesh=make_mesh(MeshConfig(data=8)))
+    tr_a.install_fault_injector(FaultInjector({3: 4}))
+    with pytest.raises(DeviceLossError):
+        tr_a.fit(bundle)
+    tr_b = Trainer(cfg_ref, bundle.feature_dim, bundle.metric_names,
+                   mesh=make_mesh(MeshConfig(data=4)))
+    state_ref, hist_ref = tr_b.resume_training(bundle)
+
+    # elastic: the same loss recovers in-process
+    cfg_e = cfg_for(tmp_path / "e", elastic=True)
+    tr_e = Trainer(cfg_e, bundle.feature_dim, bundle.metric_names,
+                   mesh=make_mesh(MeshConfig(data=8)))
+    tr_e.install_fault_injector(FaultInjector({3: 4}))
+    state_e, hist_e = tr_e.fit(bundle)
+
+    assert tr_e.remesh_count == 1
+    assert dict(tr_e.mesh.shape)["data"] == 4
+    for a, b in zip(jax.tree.leaves(state_ref), jax.tree.leaves(state_e)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert hist_ref[-1].test_loss == hist_e[-1].test_loss
