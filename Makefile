@@ -54,6 +54,13 @@
 #                         overhead) — refreshes benchmarks/
 #                         drift_bench.json; the on-chip overhead number
 #                         rides benchmarks/tpu_queue.sh drift_overhead
+#   make whatif-bench     the what-if capacity-surface gate (cached
+#                         interpolated reads >=50x the direct
+#                         synthesize->predict path at concurrency 16,
+#                         parity envelope, batched build fold, zero
+#                         post-warmup compiles) — refreshes benchmarks/
+#                         whatif_bench.json; the on-chip numbers ride
+#                         benchmarks/tpu_queue.sh whatif_surface
 
 PYTHON ?= python
 
@@ -97,6 +104,9 @@ chaos-bench:
 drift-bench:
 	$(PYTHON) benchmarks/drift_bench.py --out benchmarks/drift_bench.json
 
+whatif-bench:
+	$(PYTHON) benchmarks/whatif_bench.py --out benchmarks/whatif_bench.json
+
 .PHONY: lint lint-changed lint-fix lint-sarif lint-gate native tsan \
 	bench-multichip serve-bench-replicas obs-bench tenk-bench \
-	chaos-bench drift-bench
+	chaos-bench drift-bench whatif-bench

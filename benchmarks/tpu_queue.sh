@@ -141,6 +141,17 @@ step obs_overhead 900 env JAX_PLATFORMS=tpu python \
 # production backend.
 step drift_overhead 1200 env JAX_PLATFORMS=tpu python \
   benchmarks/drift_bench.py --out benchmarks/drift_bench_tpu.json
+# What-if capacity surfaces on-chip (round 21): the committed CPU
+# whatif_bench.json proves the >=50x cached-vs-direct ratio where the
+# direct path is a host-dispatched model call; on the accelerator the
+# direct synthesize->predict arm gets FASTER (device compute) while the
+# cached interpolation arm is host numpy either way, so the honest
+# on-chip ratio is lower — bank it so the product claim states the
+# accelerator number, not just the CPU best case.  The zero-post-warmup
+# -compile gate is the TPU-relevant half: surfaces must never grow the
+# executable count under live traffic.
+step whatif_surface 1200 env JAX_PLATFORMS=tpu python \
+  benchmarks/whatif_bench.py --out benchmarks/whatif_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

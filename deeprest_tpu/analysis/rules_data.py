@@ -63,8 +63,12 @@ class DN001DenseTrafficMaterialization(Rule):
     # feature space on every sweep — their contract is COO rows in with
     # the one dense window built through ops/densify.py, so a dense
     # per-sweep allocation here is exactly the regression DN001 exists
-    # to catch).
-    WATCH = (("train", "stream.py"), ("data", "featurize.py"))
+    # to catch).  Round 21 adds serve/surface.py: a capacity-surface
+    # build folds hundreds of scenario programs through the estimator,
+    # so an F-trailing dense staging buffer there multiplies by the
+    # whole mix grid.
+    WATCH = (("train", "stream.py"), ("data", "featurize.py"),
+             ("serve", "surface.py"))
     WATCH_DIRS = ("obs",)
 
     def run(self, project: Project) -> Iterator[Finding]:

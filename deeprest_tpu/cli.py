@@ -903,8 +903,29 @@ def cmd_serve(args) -> int:
                      "what-if synthesizer from --raw")
         synthesizer = TraceSynthesizer(space).fit(_load_buckets(args.raw))
 
+    surface_cfg = None
+    if args.surface:
+        from deeprest_tpu.config import SurfaceConfig
+
+        if synthesizer is None:
+            sys.exit("error: --surface needs --raw (capacity surfaces are "
+                     "built through the what-if synthesizer)")
+        try:
+            surface_cfg = SurfaceConfig(
+                enabled=True,
+                grid=tuple(float(x)
+                           for x in args.surface_grid.split(",") if x),
+                max_axes=args.surface_max_axes,
+                jitter=args.surface_jitter,
+                max_surfaces=args.surface_max_surfaces,
+                max_bytes=int(args.surface_max_bytes_mb * 1024 * 1024),
+                warm_async=not args.surface_sync)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+
     service = PredictionService(pred, synthesizer, backend=backend,
-                                reloader=reloader, batching=batching)
+                                reloader=reloader, batching=batching,
+                                surface=surface_cfg)
     if args.verdict_raw:
         from deeprest_tpu.config import QualityConfig
         from deeprest_tpu.obs.quality import QualityMonitor
@@ -929,6 +950,12 @@ def cmd_serve(args) -> int:
     print(json.dumps({"listening": f"http://{host}:{port}",
                       "backend": backend,
                       "whatif": synthesizer is not None,
+                      "surface": ({"grid": list(surface_cfg.grid),
+                                   "max_axes": surface_cfg.max_axes,
+                                   "jitter": surface_cfg.jitter,
+                                   "max_surfaces": surface_cfg.max_surfaces,
+                                   "max_bytes": surface_cfg.max_bytes}
+                                  if surface_cfg is not None else None),
                       "replicas": args.replicas,
                       "autoscale": autoscaler is not None,
                       "verdict": ({"raw": args.verdict_raw,
@@ -1668,6 +1695,36 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="trailing buckets in the drift live window (also "
                         "the auto-arm reference size)")
+    p.add_argument("--surface", action="store_true",
+                   help="arm the capacity-surface plane (serve/surface.py; "
+                        "needs --raw): in-space /v1/whatif reads answer by "
+                        "multilinear interpolation over precomputed "
+                        "surfaces, POST /v1/whatif/surface serves sweep-"
+                        "style peak queries, and every reload invalidates "
+                        "the cache eagerly (reason-labeled)")
+    p.add_argument("--surface-grid", default="0.5,1,2,4", metavar="S,S,...",
+                   help="per-axis scale ladder a surface sweeps around its "
+                        "base traffic program")
+    p.add_argument("--surface-max-axes", type=int, default=3, metavar="K",
+                   help="max independent per-endpoint scale axes (more "
+                        "active endpoints collapse to one shared axis; "
+                        "vertex count is len(grid)**K)")
+    p.add_argument("--surface-jitter", type=int, default=8, metavar="N",
+                   help="Monte-Carlo probe mixes per build — held out of "
+                        "the grid, they measure the surface-vs-direct "
+                        "parity envelope reported on /healthz")
+    p.add_argument("--surface-max-surfaces", type=int, default=8,
+                   metavar="N",
+                   help="LRU bound on resident surfaces")
+    p.add_argument("--surface-max-bytes-mb", type=float, default=64.0,
+                   metavar="MB",
+                   help="host-byte budget across resident surfaces "
+                        "(oversized mix spaces refuse to build and answer "
+                        "from the frontier instead)")
+    p.add_argument("--surface-sync", action="store_true",
+                   help="build cache-miss surfaces inline instead of on a "
+                        "background warm thread (deterministic tests/"
+                        "benches; first query pays the build)")
     _add_fused_infer_args(p)
     _add_sparse_args(p, serving=True)
     _add_mesh_arg(p, serving=True)

@@ -473,6 +473,48 @@ class QualityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SurfaceConfig:
+    """Capacity-surface plane (deeprest_tpu/serve/surface.py — ROADMAP
+    item 5): precomputed what-if surfaces answering ``/v1/whatif`` and
+    ``/v1/whatif/surface`` by multilinear interpolation, invalidated on
+    every backend reload.
+
+    ``grid`` is the per-axis scale ladder a surface sweeps around its
+    base program; ``max_axes`` caps the grid dimensionality (more active
+    endpoints than this collapse to one shared scale axis — vertex count
+    is ``len(grid) ** axes``); ``jitter`` is the Monte-Carlo probe count
+    behind the measured parity envelope.  ``max_surfaces``/``max_bytes``
+    bound the host-resident LRU; ``warm_async`` builds cache-miss
+    surfaces on a background thread (the miss answers from the frontier
+    meanwhile) instead of inline.
+    """
+
+    enabled: bool = False
+    grid: tuple[float, ...] = (0.5, 1.0, 2.0, 4.0)
+    max_axes: int = 3
+    jitter: int = 8
+    max_surfaces: int = 8
+    max_bytes: int = 64 * 1024 * 1024
+    warm_async: bool = True
+
+    def __post_init__(self):
+        for name in ("max_axes", "max_surfaces", "max_bytes"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"SurfaceConfig.{name}={v!r}: must be an int >= 1")
+        if not isinstance(self.jitter, int) or isinstance(self.jitter, bool) \
+                or self.jitter < 0:
+            raise ValueError(
+                f"SurfaceConfig.jitter={self.jitter!r}: must be an int >= 0")
+        grid = tuple(float(g) for g in self.grid)
+        if len(grid) < 2 or list(grid) != sorted(set(grid)) or grid[0] <= 0:
+            raise ValueError(
+                f"SurfaceConfig.grid={self.grid!r}: must be >= 2 strictly-"
+                "increasing positive scales")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device-mesh shape for pjit/GSPMD execution.
 
@@ -515,6 +557,7 @@ class Config:
     infer: InferConfig = dataclasses.field(default_factory=InferConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     quality: QualityConfig = dataclasses.field(default_factory=QualityConfig)
+    surface: SurfaceConfig = dataclasses.field(default_factory=SurfaceConfig)
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
@@ -547,6 +590,7 @@ class Config:
             infer=build(InferConfig, d.get("infer", {})),
             obs=build(ObsConfig, d.get("obs", {})),
             quality=build(QualityConfig, d.get("quality", {})),
+            surface=build(SurfaceConfig, d.get("surface", {})),
         )
 
     @classmethod

@@ -1,6 +1,6 @@
 """Serving: trained-model prediction, what-if estimation, anomaly detection,
-the portable export artifact, the cross-request micro-batching engine, and
-the HTTP prediction service."""
+the portable export artifact, the cross-request micro-batching engine,
+precomputed capacity surfaces, and the HTTP prediction service."""
 
 from deeprest_tpu.serve.batcher import (
     BatcherConfig, MicroBatcher, ShapeLadder,
@@ -8,6 +8,9 @@ from deeprest_tpu.serve.batcher import (
 from deeprest_tpu.serve.fused import FusedRolledEngine
 from deeprest_tpu.serve.predictor import (
     Predictor, rolled_prediction, rolled_prediction_reference,
+)
+from deeprest_tpu.serve.surface import (
+    CapacitySurface, CapacitySurfaceManager, MixSpace,
 )
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 from deeprest_tpu.serve.anomaly import AnomalyDetector, AnomalyReport
@@ -30,6 +33,9 @@ __all__ = [
     "Predictor",
     "rolled_prediction",
     "rolled_prediction_reference",
+    "CapacitySurface",
+    "CapacitySurfaceManager",
+    "MixSpace",
     "WhatIfEstimator",
     "AnomalyDetector",
     "AnomalyReport",

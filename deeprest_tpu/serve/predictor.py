@@ -183,6 +183,23 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             sparse_nnz_cap=(self.sparse_nnz_cap if self.sparse_feed
                             else None))
 
+    def params_digest(self) -> str:
+        """Stable fingerprint of the served params — the ``params_hash``
+        half of the capacity-surface cache key (serve/surface.py).
+        Computed ONCE per predictor (each reload builds a new instance)
+        and cached: the tree walk reads every leaf back to host exactly
+        one time, never on a request path."""
+        digest = getattr(self, "_params_digest", None)
+        if digest is None:
+            import hashlib
+
+            h = hashlib.sha1()
+            for leaf in jax.tree_util.tree_leaves(self.params):
+                # graftlint: disable=JX003 -- host data: one-time per-checkpoint fingerprint, cached on the instance
+                h.update(np.asarray(leaf).tobytes())
+            digest = self._params_digest = h.hexdigest()[:16]
+        return digest
+
     def jit_cache_size(self) -> int | None:
         """Total compiled-executable count across BOTH serving programs —
         the per-rung batched apply and the fused rolled-inference pipeline

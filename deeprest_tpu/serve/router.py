@@ -343,6 +343,7 @@ class ReplicaRouter:
         self._replicas = list(replicas)
         self._rr = 0                   # round-robin tiebreak cursor
         self._reloads = 0
+        self._last_reload_reason: str | None = None
         self._dispatched = 0
         self._batching = batching
         self._autoscaler_decision: dict | None = None
@@ -786,6 +787,8 @@ class ReplicaRouter:
                                      component="deeprest-router") as sp:
             sp.tag(reason=reason)
             self._rolling_reload_inner(fresh_backend)
+        with self._lock:
+            self._last_reload_reason = reason
         self._m_reloads_by_reason.inc(reason=reason)
 
     def _rolling_reload_inner(self, fresh_backend) -> None:
@@ -1006,6 +1009,7 @@ class ReplicaRouter:
         with self._lock:
             replicas = list(self._replicas)
             reloads = self._reloads
+            last_reload_reason = self._last_reload_reason
             dispatched = self._dispatched
             decision = self._autoscaler_decision
             health = {
@@ -1032,6 +1036,7 @@ class ReplicaRouter:
                 if r.available() and not health[id(r)].ejected),
             "dispatched": dispatched,
             "rolling_reloads": reloads,
+            "last_reload_reason": last_reload_reason,
             "admission": self.admission.stats(),
             "health": self.health_totals(),
             "autoscaler": decision,

@@ -17,6 +17,7 @@ Routes (all JSON):
     POST /v1/predict          {"traffic": [[F floats] x T]}          → [T,E,Q]
     POST /v1/whatif           {"expected_traffic": [{endpoint: n}xT]} → series
     POST /v1/whatif/scaling   {"baseline_traffic", "hypothetical_traffic"}
+    POST /v1/whatif/surface   {"base_traffic", "scales"|"factor"}     → peaks
     POST /v1/anomaly          {"traffic", "observed", "tolerance"?, "min_run"?}
     POST /v1/profile          {"seconds"?, "out_dir"?} → jax.profiler window
 
@@ -50,6 +51,7 @@ from deeprest_tpu.obs import metrics as obs_metrics
 from deeprest_tpu.obs import spans as obs_spans
 from deeprest_tpu.serve.anomaly import AnomalyDetector
 from deeprest_tpu.serve.batcher import BatcherConfig, MicroBatcher
+from deeprest_tpu.serve.surface import CapacitySurfaceManager
 from deeprest_tpu.serve.whatif import WhatIfEstimator
 
 
@@ -189,10 +191,18 @@ class PredictionService:
     default) keeps the per-request dispatch path — each request still
     goes through the backend's shape ladder, so the jit cache stays
     rung-bounded either way.
+
+    ``surface`` (optional :class:`~deeprest_tpu.config.SurfaceConfig`
+    with ``enabled=True``) attaches the capacity-surface plane
+    (serve/surface.py): in-space ``/v1/whatif`` reads answer by
+    interpolation over precomputed surfaces, ``/v1/whatif/surface``
+    serves sweep-style peak queries, and every backend reload
+    invalidates the cache eagerly with its reason label.
     """
 
     def __init__(self, predictor, synthesizer=None, backend: str = "",
-                 reloader=None, batching: BatcherConfig | None = None):
+                 reloader=None, batching: BatcherConfig | None = None,
+                 surface=None):
         self.backend = backend
         self._synthesizer = synthesizer
         self._reloader = reloader
@@ -225,6 +235,14 @@ class PredictionService:
         self._quality_ingestor = None
         self.whatif = (WhatIfEstimator(predictor, synthesizer)
                        if synthesizer is not None else None)
+        # Capacity-surface plane: needs the what-if pipeline (a surface
+        # is built THROUGH the estimator), so it silently stays off
+        # without a synthesizer — the CLI errors on that combination up
+        # front.
+        self.surface = (CapacitySurfaceManager(surface)
+                        if surface is not None
+                        and getattr(surface, "enabled", False)
+                        and self.whatif is not None else None)
         if batching is not None:
             self.enable_batching(batching)
         # Registered LAST: the render-time collector snapshots state the
@@ -293,6 +311,9 @@ class PredictionService:
             self.batching = None
             pred = self.predictor
             ingestor, self._quality_ingestor = self._quality_ingestor, None
+            surface, self.surface = self.surface, None
+        if surface is not None:
+            surface.close()       # join warm-builder threads
         if ingestor is not None:
             ingestor.stop()
         detach = getattr(pred, "attach_batcher", None)
@@ -312,8 +333,35 @@ class PredictionService:
         fresh = self._reloader.poll()
         if fresh is None:
             return
+        self.reload_from(fresh, reason="watch")
+
+    def reload_from(self, fresh, reason: str = "manual") -> None:
+        """Swap in ``fresh`` NOW.  ``reason`` labels the reload end to
+        end: the router's per-reason reload counter, and the capacity-
+        surface invalidation it forces — "watch" for the checkpoint-dir
+        cadence, "drift" when the DriftController pulled the trigger,
+        "manual" for operator swaps.
+
+        The surface cache is bracketed around the swap (``begin_reload``
+        → swap → ``end_reload``): while the backend is mid-swap no
+        cached surface is readable, and afterwards the store is empty —
+        so no response can ever interpolate a surface built from
+        pre-reload params (the round-13 no-mixed-params discipline,
+        extended to cached answers).  Drift-triggered reloads therefore
+        invalidate EAGERLY, not on next touch.
+        """
         with self._lock:
             current = self.predictor
+            surface = self.surface
+        if surface is not None:
+            surface.begin_reload()
+        try:
+            self._swap_backend(current, fresh, reason)
+        finally:
+            if surface is not None:
+                surface.end_reload(reason=reason)
+
+    def _swap_backend(self, current, fresh, reason: str) -> None:
         if hasattr(current, "rolling_reload_from"):
             # Multi-replica router: drain and re-image one replica at a
             # time (zero downtime; no request ever observes mixed old/new
@@ -321,7 +369,7 @@ class PredictionService:
             # backend its replica held when it was dispatched).
             fresh_whatif = (WhatIfEstimator(current, self._synthesizer)
                             if self._synthesizer is not None else None)
-            current.rolling_reload_from(fresh)
+            current.rolling_reload_from(fresh, reason=reason)
             with self._lock:
                 self.whatif = fresh_whatif
                 self.reloads += 1
@@ -483,6 +531,13 @@ class PredictionService:
             out["quality"] = {"armed": v.get("armed", False),
                               "sweeps": v.get("sweeps", 0),
                               "states": v.get("states")}
+        with self._lock:
+            surface = self.surface
+        if surface is not None:
+            # capacity-surface plane: resident set, byte budget, hit/
+            # miss/build/invalidation ledger, measured parity envelope
+            # (additive key; absent when the plane is off)
+            out["surface"] = surface.stats()
         return out
 
     def verdict(self) -> dict:
@@ -577,14 +632,73 @@ class PredictionService:
         pred, whatif, _, _ = self._snapshot()
         est = self._require_whatif(whatif)
         prog = self._traffic_program(payload, "expected_traffic", pred)
+        with self._lock:
+            surface = self.surface
+        if surface is not None:
+            # Capacity-surface interception: a program that is an
+            # int-rounded scaling of a cached surface's base answers by
+            # interpolation (microseconds, no dispatch).  The response
+            # grows an additive "surface" key; the existing wire fields
+            # are untouched.  Misses warm a surface anchored at this
+            # program so the NEXT scaled variant hits.
+            hit = surface.lookup_program(pred, prog,
+                                         seed=self._seed(payload))
+            if hit is not None:
+                series_arr, meta = hit
+                return {"estimates": self._bands_payload(est, series_arr),
+                        "surface": meta}
+            surface.note_miss()
+            surface.maybe_warm(pred, est, prog, seed=self._seed(payload))
         try:
             series = est.estimate(prog, seed=self._seed(payload))
         except KeyError as e:   # unknown endpoint in the traffic program
             raise ServingError(str(e)) from None
-        return {"estimates": {
+        out = {"estimates": {
             metric: {q: v.tolist() for q, v in bands.items()}
             for metric, bands in series.items()
         }}
+        if surface is not None:
+            out["surface"] = {"hit": False}
+        return out
+
+    @staticmethod
+    def _bands_payload(est, series_arr) -> dict:
+        # one C-level transpose+tolist instead of metrics*quantiles
+        # slice/tolist pairs — same payload as est._bands + tolist,
+        # on the cached read path's serialization budget
+        nested = np.asarray(series_arr).transpose(1, 2, 0).tolist()
+        pred = est.predictor
+        qkeys = [f"q{int(q * 100):02d}" for q in pred.quantiles]
+        return {metric: dict(zip(qkeys, rows))
+                for metric, rows in zip(pred.metric_names, nested)}
+
+    def whatif_surface(self, payload: dict) -> dict:
+        """``POST /v1/whatif/surface`` — sweep-semantics peaks at one
+        point of a mix space around ``base_traffic`` (``scales`` per
+        endpoint or a uniform ``factor``), answered from the capacity
+        surface when resident (building it synchronously when ``wait``
+        is set) and from a direct frontier estimate otherwise."""
+        pred, whatif, _, _ = self._snapshot()
+        est = self._require_whatif(whatif)
+        with self._lock:
+            surface = self.surface
+        if surface is None:
+            raise ServingError(
+                "capacity surfaces disabled: start the server with "
+                "--surface (requires --raw for the trace synthesizer)",
+                status=503)
+        base = self._traffic_program(payload, "base_traffic", pred)
+        try:
+            return surface.query(
+                pred, est, base,
+                scales=payload.get("scales"),
+                factor=payload.get("factor"),
+                seed=self._seed(payload),
+                wait=bool(payload.get("wait", False)))
+        except (KeyError, ValueError) as e:
+            if isinstance(e, ServingError):
+                raise
+            raise ServingError(str(e)) from None
 
     def whatif_scaling(self, payload: dict) -> dict:
         pred, whatif, _, _ = self._snapshot()
@@ -743,6 +857,7 @@ _POST_ROUTES = {
     "/v1/predict": "predict",
     "/v1/whatif": "whatif_estimate",
     "/v1/whatif/scaling": "whatif_scaling",
+    "/v1/whatif/surface": "whatif_surface",
     "/v1/anomaly": "anomaly",
 }
 # Ops routes skip the admission gate: shedding a profiler request under

@@ -19,14 +19,39 @@ new.
 
 from __future__ import annotations
 
+import json
+import threading
+from collections import OrderedDict
+
 import numpy as np
 
 from deeprest_tpu.data.synthesize import TraceSynthesizer
 from deeprest_tpu.serve.predictor import Predictor
 
+# Per-estimator raw-prediction memo size.  Sized for the repeat pattern
+# that actually occurs (the BASELINE program re-estimated by every
+# scaling_factor/sweep call against the same snapshot), not as a general
+# result cache — that is serve/surface.py's job.
+_RAW_CACHE_MAX = 32
+
+
+def _program_key(program: list[dict], seed: int) -> str:
+    """Canonical memo key for one (traffic program, synthesis seed)."""
+    return json.dumps(program, sort_keys=True,
+                      separators=(",", ":")) + f"|{seed}"
+
 
 class WhatIfEstimator:
-    """Synthesizer + predictor, composed."""
+    """Synthesizer + predictor, composed.
+
+    Estimation is memoized per (traffic program, seed) in a small LRU:
+    ``scaling_factor`` and ``sweep`` re-estimate the same BASELINE
+    program on every call, and the what-if surface plane
+    (serve/surface.py) probes overlapping mixes.  The memo lives on the
+    estimator instance, and every reload path builds a FRESH estimator
+    over the fresh backend (server.maybe_reload/reload_from), so a memo
+    entry can never outlive the params snapshot that produced it.
+    """
 
     def __init__(self, predictor: Predictor, synthesizer: TraceSynthesizer):
         if synthesizer.space.capacity != predictor.feature_dim:
@@ -36,6 +61,14 @@ class WhatIfEstimator:
             )
         self.predictor = predictor
         self.synthesizer = synthesizer
+        # raw [T, E, Q] results keyed by _program_key; entries are
+        # write-locked numpy arrays shared across callers.  The lock
+        # guards the OrderedDict + hit/miss counters only — synthesis and
+        # prediction always run OUTSIDE it.
+        self._raw_cache: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._raw_lock = threading.Lock()
+        self.raw_cache_hits = 0
+        self.raw_cache_misses = 0
 
     @property
     def endpoints(self) -> list[str]:
@@ -91,21 +124,80 @@ class WhatIfEstimator:
         ``seed + i`` — scenario i of a sweep is reproducible regardless
         of batch composition).
         """
+        raws = self.estimate_many_raw(traffic_programs, seed=seed,
+                                      seeds=seeds)
+        return [self._bands(p) for p in raws]
+
+    def estimate_many_raw(
+        self,
+        traffic_programs: list[list[dict[str, int]]],
+        seed: int = 0,
+        seeds: list[int] | None = None,
+        cache: bool = True,
+    ) -> list[np.ndarray]:
+        """Like :meth:`estimate_many` but returns the raw ``[T, E, Q]``
+        prediction arrays (read-only) instead of band dicts — the shape
+        the capacity-surface plane stacks into interpolation grids.
+
+        With ``cache=True`` (default), each (program, seed) result is
+        memoized in a per-estimator LRU: repeated baselines across
+        ``scaling_factor``/``sweep`` calls cost one prediction train
+        total.  Only the MISSES synthesize and fold into the device
+        batch; a fully-cached call does no dispatch at all.  Surface
+        builds pass ``cache=False`` — their thousands of vertices are
+        stored once in the surface itself and would only churn this LRU.
+        """
         if seeds is None:
             seeds = [seed + i for i in range(len(traffic_programs))]
         if len(seeds) != len(traffic_programs):
             raise ValueError(
                 f"{len(seeds)} seeds for {len(traffic_programs)} programs")
-        series = [
-            self.synthesizer.synthesize_series(program, seed=s)
-            for program, s in zip(traffic_programs, seeds)
-        ]
-        many = getattr(self.predictor, "predict_series_many", None)
-        if many is not None:
-            preds = many(series)
-        else:
-            preds = [self.predictor.predict_series(x) for x in series]
-        return [self._bands(p) for p in preds]
+        n = len(traffic_programs)
+        out: list[np.ndarray | None] = [None] * n
+        miss_idx = list(range(n))
+        keys: list[str] | None = None
+        if cache:
+            keys = [_program_key(p, s)
+                    for p, s in zip(traffic_programs, seeds)]
+            miss_idx = []
+            with self._raw_lock:
+                for i, k in enumerate(keys):
+                    hit = self._raw_cache.get(k)
+                    if hit is not None:
+                        self._raw_cache.move_to_end(k)
+                        self.raw_cache_hits += 1
+                        out[i] = hit
+                    else:
+                        self.raw_cache_misses += 1
+                        miss_idx.append(i)
+        if miss_idx:
+            series = [
+                self.synthesizer.synthesize_series(
+                    traffic_programs[i], seed=seeds[i])
+                for i in miss_idx
+            ]
+            many = getattr(self.predictor, "predict_series_many", None)
+            if many is not None:
+                preds = many(series)
+            else:
+                preds = [self.predictor.predict_series(x) for x in series]
+            for i, p in zip(miss_idx, preds):
+                # graftlint: disable=JX003 -- designed sink: the memo stores host numpy; this is the one materialization point
+                arr = np.asarray(p, dtype=np.float32)
+                # shared across future cache hits: freeze so no caller
+                # can corrupt another's result
+                arr.setflags(write=False)
+                out[i] = arr
+            if cache:
+                with self._raw_lock:
+                    for i in miss_idx:
+                        # concurrent misses of the same key both insert;
+                        # values are deterministic, so last-wins is fine
+                        self._raw_cache[keys[i]] = out[i]
+                        self._raw_cache.move_to_end(keys[i])
+                    while len(self._raw_cache) > _RAW_CACHE_MAX:
+                        self._raw_cache.popitem(last=False)
+        return out
 
     def sweep(
         self,
@@ -162,7 +254,10 @@ class WhatIfEstimator:
 
         Both programs fold into one batched prediction train through
         ``estimate_many`` (shared fused pages — this replaced the earlier
-        two-thread MicroBatcher workaround).  Degenerate peaks follow one
+        two-thread MicroBatcher workaround), and the per-estimator memo
+        means a repeated baseline (every demo interaction re-compares
+        against "today's traffic") is estimated once per snapshot, not
+        once per call.  Degenerate peaks follow one
         convention for BOTH metric kinds: zero baseline and zero
         hypothetical means "no change" (1.0); zero baseline with real
         hypothetical load is unbounded (inf) — previously absolute metrics

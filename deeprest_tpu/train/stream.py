@@ -1076,6 +1076,24 @@ class StreamingTrainer:
             thread.join(timeout=10.0)
 
 
+def _accepts_reason(fn) -> bool:
+    """Does ``fn`` take a ``reason`` keyword (directly or via
+    ``**kwargs``)?  The DriftController's reload_fn contract predates
+    reason labels; this probe lets reason-aware targets opt in without
+    breaking single-arg closures already deployed."""
+    if fn is None:
+        return False
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters.values()
+    except (TypeError, ValueError):
+        return False
+    return any(p.name == "reason"
+               or p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in params)
+
+
 class DriftController:
     """The drift→retrain→hot-reload loop over one StreamingTrainer
     (ROADMAP item 6's act half; obs/quality.py is the detect half).
@@ -1101,7 +1119,10 @@ class DriftController:
       ``reload_fn(checkpoint_path)`` hot-swaps the serving plane (the
       e2e loop passes a closure over
       ``ReplicaRouter.rolling_reload_from``; a plane watching the
-      checkpoint dir via ``serve --watch`` needs no reload_fn at all);
+      checkpoint dir via ``serve --watch`` needs no reload_fn at all) —
+      reason-aware targets additionally receive ``reason=<trigger>``,
+      which labels the rolling reload AND eagerly invalidates the
+      serving plane's capacity-surface cache (serve/surface.py);
     - every decision is observable: obs counters by reason + spans
       around retrain triggers and reloads.
 
@@ -1117,6 +1138,12 @@ class DriftController:
         self.config = config or QualityConfig(enabled=True)
         self._st = trainer
         self._reload_fn = reload_fn
+        # Reason-aware reload targets (service.reload_from, a closure
+        # over rolling_reload_from) get the TRIGGER as their reload
+        # reason — the capacity-surface cache invalidates eagerly under
+        # that label, and /metrics tells drift swaps from cadence ones.
+        # Plain single-arg callables keep working unchanged.
+        self._reload_takes_reason = _accepts_reason(reload_fn)
         self.monitor = monitor          # built at the first refresh
         self._apply = None              # jitted once, params as args
         self._since_sweep = 0
@@ -1186,7 +1213,11 @@ class DriftController:
                         "drift.reload", component="deeprest-drift") as sp:
                     sp.tag(checkpoint=result.checkpoint_path,
                            trigger=result.trigger)
-                    self._reload_fn(result.checkpoint_path)
+                    if self._reload_takes_reason:
+                        self._reload_fn(result.checkpoint_path,
+                                        reason=result.trigger)
+                    else:
+                        self._reload_fn(result.checkpoint_path)
                 self.stats["reloads"] += 1
                 self._m_reloads.inc()
 

@@ -891,6 +891,29 @@ def test_dn001_obs_directory_pair():
     assert not findings_for("DN001", DN001_OBS_BAD, rel="ops/densify.py")
 
 
+# round 21: serve/surface.py joins the DN001 watchlist — a capacity
+# surface build folds the whole mix grid through the estimator, so one
+# F-wide dense staging buffer there multiplies by hundreds of scenarios
+
+
+def test_dn001_surface_module_pair():
+    assert_pair("DN001", DN001_BAD, DN001_GOOD, rel="serve/surface.py")
+
+
+def test_dn002_leaves_surface_sites_to_dn001():
+    # with surface.py on DN001's watchlist, a marker-shaped alloc there
+    # is DN001's finding — DN002 must not double-report it even though
+    # serve/ is a DN002 zone
+    assert not findings_for("DN002", DN001_BAD, rel="serve/surface.py")
+    assert findings_for("DN001", DN001_BAD, rel="serve/surface.py")
+
+
+def test_jx003_surface_module_pair():
+    # the surface build loop folds scenario batches — a per-iteration
+    # device→host readback there stalls the whole grid sweep
+    assert_pair("JX003", JX003_BAD, JX003_GOOD, rel="serve/surface.py")
+
+
 def test_hy001_unused_import_pair():
     bad = "import os\nimport sys\n\nprint(sys.argv)\n"
     good = "import sys\n\nprint(sys.argv)\n"

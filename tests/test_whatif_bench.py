@@ -1,0 +1,83 @@
+"""whatif_bench plumbing gate (tier-1): the --quick arms run end-to-end
+on the REAL corpus→space→synthesizer pipeline, their gates hold, and the
+committed full-mode artifact keeps asserting the ≥50x cached-read claim.
+
+Quick mode keeps tier-1 honest about PLUMBING (world build, the warmed
+surface answering every in-hull request, the concurrency-16 hammer, the
+zero-compile probe) with a relaxed ratio gate (5x — CPU timing noise at
+small request counts must not flake tier-1); the committed
+benchmarks/whatif_bench.json is the full-mode record whose gates this
+file re-checks without re-running the bench.  The quick bench runs ONCE
+per module — its record and headline line feed every test below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED = os.path.join(REPO, "benchmarks", "whatif_bench.json")
+
+
+@pytest.fixture(scope="module")
+def quick_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("whatif_bench") / "whatif_bench.json"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "whatif_bench.py"),
+         "--quick", "--headline", "--out", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return json.loads(out.read_text()), proc.stdout
+
+
+def test_whatif_bench_quick_gates(quick_run):
+    rec, _ = quick_run
+    assert rec["mode"] == "quick"
+    assert rec["concurrency"] == 16
+
+    assert rec["speedup"] >= rec["speedup_gate"] == 5.0
+    cached = rec["cached"]
+    assert cached["ok"] and cached["misses"] == 0
+    assert cached["parity_max_rel_err"] is not None
+    assert cached["parity_max_rel_err"] <= rec["parity_budget"]
+    assert rec["build"]["ok"]
+    assert rec["direct"]["distinct_programs"] > 32   # the raw memo size
+
+
+def test_whatif_bench_quick_zero_postwarmup_compiles(quick_run):
+    rec, _ = quick_run
+    # None only when the running jax has no cache probe; equality is the
+    # zero-new-executables guarantee across BOTH timed arms
+    if rec["compiles_before"] is not None:
+        assert rec["compiles_after"] == rec["compiles_before"]
+
+
+def test_headline_emits_schema_v12_keys(quick_run):
+    """bench.py (schema v12) consumes exactly these keys."""
+    _, stdout = quick_run
+    line = stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert "whatif_surface_rps" in rec
+    assert "whatif_surface_speedup" in rec
+    assert rec["whatif_surface_rps"] > 0
+
+
+def test_committed_record_keeps_the_claim():
+    """The committed full-mode dossier: cached interpolated reads ≥50x
+    the direct synthesize→predict path at concurrency 16, every answer a
+    hit, parity inside the pinned envelope, zero post-warmup compiles."""
+    with open(COMMITTED, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rec["mode"] == "full"
+    assert rec["speedup"] >= 50.0
+    assert rec["cached"]["ok"] and rec["cached"]["misses"] == 0
+    assert rec["cached"]["parity_max_rel_err"] <= rec["parity_budget"]
+    assert rec["build"]["fold_speedup"] >= 1.5
+    if rec["compiles_before"] is not None:
+        assert rec["compiles_after"] == rec["compiles_before"]
