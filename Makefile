@@ -61,6 +61,13 @@
 #                         post-warmup compiles) — refreshes benchmarks/
 #                         whatif_bench.json; the on-chip numbers ride
 #                         benchmarks/tpu_queue.sh whatif_surface
+#   make quant-bench      the quantized-serving gate (int8 weight tree
+#                         >=3.5x smaller than f32, serving drift inside
+#                         the pinned parity envelope, executable count
+#                         flat across off/int8/bf16 and frozen
+#                         post-warmup) — refreshes benchmarks/
+#                         quant_bench.json; the on-chip bandwidth win
+#                         rides benchmarks/tpu_queue.sh quant_serve
 
 PYTHON ?= python
 
@@ -107,6 +114,9 @@ drift-bench:
 whatif-bench:
 	$(PYTHON) benchmarks/whatif_bench.py --out benchmarks/whatif_bench.json
 
+quant-bench:
+	$(PYTHON) benchmarks/quant_bench.py --out benchmarks/quant_bench.json
+
 .PHONY: lint lint-changed lint-fix lint-sarif lint-gate native tsan \
 	bench-multichip serve-bench-replicas obs-bench tenk-bench \
-	chaos-bench drift-bench whatif-bench
+	chaos-bench drift-bench whatif-bench quant-bench

@@ -776,6 +776,37 @@ def main() -> None:
     except Exception as exc:
         print(f"bench: whatif measurement failed: {exc}", file=sys.stderr)
 
+    # Quantized-serving headline (schema v13, NEW keys): the int8
+    # serving weight-tree bytes plus the worst measured parity-envelope
+    # cell from the quick quantized world (benchmarks/quant_bench.py has
+    # the full record; the committed quant_bench.json asserts the >=3.5x
+    # byte ratio, envelope-bounded serving drift, and the flat/frozen
+    # executable ladder).  Child process, CPU backend — the parent's
+    # never-init-a-backend contract holds.
+    quant_bytes = quant_parity = None
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "quant_bench.py"),
+             "--quick", "--headline"],
+            capture_output=True, text=True, timeout=900, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                quant_bytes = int(rec["quant_weight_bytes"])
+                quant_parity = float(rec["quant_parity_max"])
+                break
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                continue
+        if quant_bytes is None:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+            print(f"bench: quant headline produced no record: "
+                  f"{' | '.join(tail)}", file=sys.stderr)
+    except Exception as exc:
+        print(f"bench: quant measurement failed: {exc}", file=sys.stderr)
+
     # Elastic-remesh recovery headline (schema v11, NEW key): the worst
     # detect->rebuild->restore wall time across the committed chaos
     # storm's elastic arm (benchmarks/chaos_bench.json — `make
@@ -794,6 +825,13 @@ def main() -> None:
 
     perf = _mfu_block(measured, F)
     result = {
+        # v13: the quantized serving tier adds quant_weight_bytes (the
+        # int8 serving weight-tree bytes on the quick world —
+        # benchmarks/quant_bench.py; the committed quant_bench.json
+        # asserts the >=3.5x f32/int8 byte ratio) and quant_parity_max
+        # (the worst measured parity-envelope cell vs the f32 reference,
+        # enforced at every load) — NEW keys only; every v12 key keeps
+        # its meaning.
         # v12: whatif_surface_rps is the what-if capacity-surface
         # headline (cached interpolated /v1/whatif reads per second at
         # concurrency 16 on the quick real-pipeline world —
@@ -851,7 +889,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 12,
+        "schema_version": 13,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -913,6 +951,10 @@ def main() -> None:
         result["remesh_recovery_s"] = round(float(remesh_recovery), 4)
     if whatif_rps is not None:
         result["whatif_surface_rps"] = round(whatif_rps, 1)
+    if quant_bytes is not None:
+        result["quant_weight_bytes"] = quant_bytes
+    if quant_parity is not None:
+        result["quant_parity_max"] = quant_parity
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

@@ -152,6 +152,15 @@ step drift_overhead 1200 env JAX_PLATFORMS=tpu python \
 # executable count under live traffic.
 step whatif_surface 1200 env JAX_PLATFORMS=tpu python \
   benchmarks/whatif_bench.py --out benchmarks/whatif_bench_tpu.json
+# quant_bench.json's CPU record proves bytes/parity/executable-flatness
+# but footnotes away both timings (dequant ADDS CPU FLOPs; device_put
+# there is leaf-overhead-bound).  On the chip the claim inverts: serving
+# is weight-BANDWIDTH-bound, so the 3.9x smaller int8 tree is the half
+# the product actually sells — bank the on-chip windows/sec and
+# host->HBM transfer ratios here, and only ever state the speedup from
+# this artifact, never from the CPU one.
+step quant_serve 1200 env JAX_PLATFORMS=tpu python \
+  benchmarks/quant_bench.py --out benchmarks/quant_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -82,9 +82,18 @@ TOP = "top"
 
 # dtype promotion rank (JAX-flavored): weak scalars sit between the
 # strong ints and the strong floats so int<op>wfloat promotes to float
-# (rank of the float side) and wfloat<op>f32 stays f32.
-_DTYPE_RANK = {"bool": 0, "wint": 1, "int": 2, "wfloat": 3,
-               "bf16": 4, "f32": 5, "f64": 6}
+# (rank of the float side) and wfloat<op>f32 stays f32.  "i8" is the
+# quantized-weight storage dtype (round 22): it is tracked separately
+# from the generic "int" member because leaving i8 — promoting into any
+# float — is only legal inside the sanctioned dequant helper
+# (ops/quantize.py dequantize); everywhere else that promotion is a
+# silent de-quantization bug and QT001 fires at the origin.
+_DTYPE_RANK = {"bool": 0, "wint": 1, "i8": 2, "int": 3, "wfloat": 4,
+               "bf16": 5, "f32": 6, "f64": 7}
+
+# float members of the rank lattice — an i8 value reaching any of these
+# outside the sanctioned dequant site is the QT001 hazard class
+_FLOATS = ("wfloat", "bf16", "f32", "f64")
 
 _MAX_ORIGINS = 4        # dense-origin set widening cap
 _MAX_ELTS = 8           # tuple-structure tracking cap (arity)
@@ -117,7 +126,7 @@ def promote_dtype(a: str, b: str) -> str:
         # keeps the array dtype (hi already is the array side); but
         # int op wfloat DOES become float — Python float constants
         # silently promote integer arrays (the JX006 class)
-        if hi == "wfloat" and lo in ("bool", "wint", "int"):
+        if hi == "wfloat" and lo in ("bool", "wint", "i8", "int"):
             return "wfloat"
         return hi
     return TOP
@@ -228,9 +237,12 @@ _F64_NAMES = {"np.float64", "numpy.float64", "jnp.float64",
 _F32_NAMES = {"np.float32", "numpy.float32", "jnp.float32",
               "jax.numpy.float32"}
 _BF16_NAMES = {"jnp.bfloat16", "jax.numpy.bfloat16"}
-_INT_NAMES = {"np.int8", "np.int16", "np.int32", "np.int64", "np.intp",
+# int8 is split out of the generic int bucket (round 22): it is the
+# quantized-weight storage dtype and QT001 tracks where it may leave
+_I8_NAMES = {"np.int8", "numpy.int8", "jnp.int8", "jax.numpy.int8"}
+_INT_NAMES = {"np.int16", "np.int32", "np.int64", "np.intp",
               "np.uint8", "np.uint16", "np.uint32", "np.uint64",
-              "jnp.int8", "jnp.int16", "jnp.int32", "jnp.int64",
+              "jnp.int16", "jnp.int32", "jnp.int64",
               "numpy.int32", "numpy.int64", "int"}
 # methods that preserve array identity closely enough to carry taint
 _TAINT_PRESERVING_METHODS = {"astype", "copy", "reshape", "view",
@@ -279,12 +291,15 @@ def _dtype_of_annotation(node: ast.AST | None) -> str:
         return "f32"
     if dotted in _BF16_NAMES:
         return "bf16"
+    if dotted in _I8_NAMES:
+        return "i8"
     if dotted in _INT_NAMES:
         return "int"
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         s = node.value
         return {"float64": "f64", "float32": "f32", "bfloat16": "bf16",
-                "int32": "int", "int64": "int", "bool": "bool"}.get(s, TOP)
+                "int8": "i8", "int32": "int", "int64": "int",
+                "bool": "bool"}.get(s, TOP)
     return TOP
 
 
@@ -341,6 +356,21 @@ class Promotion:
 
 
 @dataclasses.dataclass(frozen=True)
+class I8Hazard:
+    """An int8 value escaping into float math OUTSIDE the sanctioned
+    dequant site (round 22, QT001): a quantized weight reaching a
+    matmul/add/astype as float means the scale multiply was skipped —
+    the output is silently wrong by ~scale^-1, not slightly off.  The
+    engine never records hazards inside ops/quantize.py (``dequantize``
+    is the one place i8 -> f32 is the whole point)."""
+
+    key: FuncKey | None
+    rel: str
+    node: ast.AST
+    why: str                     # "promotion:f32", "astype:bf16", "matmul"
+
+
+@dataclasses.dataclass(frozen=True)
 class NpCall:
     """A float64-defaulting np.* producer call (syntactic)."""
 
@@ -384,6 +414,7 @@ class ValueFlow:
         self.zone_hits: dict[tuple[str, int, int], FuncKey] = {}
         self.crossings: list[Crossing] = []
         self.promotions: list[Promotion] = []
+        self.i8_hazards: list[I8Hazard] = []
 
         # interprocedural state
         self._params: dict[FuncKey, dict[str, AbsVal]] = {}
@@ -471,6 +502,7 @@ class ValueFlow:
             self.zone_hits = {}
             self.crossings = []
             self.promotions = []
+            self.i8_hazards = []
             for sf in self.project.files:
                 self._analyze_module(sf)
             for key, node in self.graph.functions.items():
@@ -776,6 +808,22 @@ class ValueFlow:
         if hazard:
             self.promotions.append(Promotion(
                 self._key, self._rel, node, a, b))
+        # QT001 (round 22): int8 meeting float math outside the
+        # sanctioned dequant helper skipped the scale multiply
+        if "i8" in (a, b) and {a, b} & set(_FLOATS):
+            other = b if a == "i8" else a
+            self._note_i8_hazard(node, f"promotion:{other}")
+
+    def _in_sanctioned_dequant(self) -> bool:
+        """True inside ops/quantize.py — the ONE module where i8→float
+        is the point (``dequantize`` applies the scale there)."""
+        parts = tuple(self._rel.replace("\\", "/").split("/"))
+        return len(parts) >= 2 and parts[-2:] == ("ops", "quantize.py")
+
+    def _note_i8_hazard(self, node: ast.AST, why: str) -> None:
+        if self._in_sanctioned_dequant():
+            return
+        self.i8_hazards.append(I8Hazard(self._key, self._rel, node, why))
 
     # -- call evaluation --------------------------------------------------
 
@@ -810,6 +858,10 @@ class ValueFlow:
             dtype = recv.dtype
             if node.func.attr == "astype" and node.args:
                 dtype = _dtype_of_annotation(node.args[0])
+                if recv.dtype == "i8" and dtype in _FLOATS:
+                    # raw-cast de-quantization (QT001): .astype(f32) on
+                    # an int8 weight drops the per-channel scale
+                    self._note_i8_hazard(node, f"astype:{dtype}")
             return dataclasses.replace(recv, dtype=dtype, elts=None)
 
         if dotted is not None:
@@ -858,6 +910,15 @@ class ValueFlow:
             # memory and feed bytes)
             root = dotted.split(".", 1)[0]
             if root in ("jnp", "jax") or dotted.startswith("jax.numpy."):
+                # matmul-family consumption of an i8 operand (QT001):
+                # jnp.dot(int8_w, x) promotes inside XLA with the scale
+                # never applied — the hazard fires HERE, at the consumer,
+                # even when no BinOp ever sees the int8 value
+                tail = dotted.rsplit(".", 1)[-1]
+                if tail in ("einsum", "dot", "matmul", "tensordot",
+                            "dot_general") and any(
+                                v.dtype == "i8" for v in arg_vals):
+                    self._note_i8_hazard(node, tail)
                 dtype = TOP
                 if "dtype" in kw_vals:
                     dtype = _dtype_of_annotation(

@@ -66,9 +66,11 @@ class DN001DenseTrafficMaterialization(Rule):
     # to catch).  Round 21 adds serve/surface.py: a capacity-surface
     # build folds hundreds of scenario programs through the estimator,
     # so an F-trailing dense staging buffer there multiplies by the
-    # whole mix grid.
+    # whole mix grid.  Round 22 adds ops/quantize.py: quantization walks
+    # every weight tensor at load time — a host-side F-trailing staging
+    # buffer there would charge the whole feature width per reload.
     WATCH = (("train", "stream.py"), ("data", "featurize.py"),
-             ("serve", "surface.py"))
+             ("serve", "surface.py"), ("ops", "quantize.py"))
     WATCH_DIRS = ("obs",)
 
     def run(self, project: Project) -> Iterator[Finding]:

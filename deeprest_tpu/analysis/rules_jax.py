@@ -713,3 +713,53 @@ class JX007TransitiveHostDeviceCrossing(Rule):
                 "sync stalling the pipeline; accumulate on device and "
                 "read back once after the loop (or suppress with a "
                 "reason if this is the designed sink)")
+
+
+@register
+class QT001SilentInt8Promotion(Rule):
+    id = "QT001"
+    title = ("int8 quantized weight promoted to float outside the "
+             "sanctioned dequant helper (ops/quantize.py dequantize): "
+             "the per-channel scale multiply was skipped")
+    guards = ("round 22 stores GRU/dense weights as per-output-channel "
+              "symmetric int8 with a separate f32 scale; the ONLY legal "
+              "way for that int8 tensor to meet float math is "
+              "ops/quantize.py dequantize, which applies the scale.  A "
+              "raw astype(f32), an i8 x float BinOp, or an int8 operand "
+              "handed straight to einsum/dot/matmul promotes inside XLA "
+              "with the scale never applied — outputs wrong by ~1/scale "
+              "per channel, and nothing crashes.  graftflow's dtype "
+              "lattice tracks i8 as its own member and records every "
+              "such escape interprocedurally; the rule scopes to ops/ "
+              "and serve/ (the planes quantized weights live in) so "
+              "analysis fixtures and host tooling stay silent")
+
+    # directories where quantized weight tensors actually circulate;
+    # an i8 escape anywhere else is not weight data (fixture files,
+    # host-side tooling) and stays silent
+    HOT_DIRS = ("ops", "serve")
+
+    def run(self, project: Project) -> Iterator[Finding]:
+        from deeprest_tpu.analysis.dataflow import ValueFlow
+
+        flow = ValueFlow.of(project)
+        seen: set[tuple] = set()
+        for h in flow.i8_hazards:
+            parts = tuple(h.rel.replace("\\", "/").split("/"))
+            if not any(d in parts[:-1] for d in self.HOT_DIRS):
+                continue
+            sf = project.by_rel.get(h.rel)
+            if sf is None:
+                continue
+            dk = (h.rel, getattr(h.node, "lineno", 0),
+                  getattr(h.node, "col_offset", 0), h.why[:40])
+            if dk in seen:
+                continue
+            seen.add(dk)
+            yield sf.finding(
+                h.node, self.id,
+                f"int8 value reaches float math here ({h.why}) without "
+                "the sanctioned dequant: route it through "
+                "ops/quantize.py dequantize() so the per-channel scale "
+                "is applied — a raw promotion serves outputs wrong by "
+                "~1/scale and nothing crashes")

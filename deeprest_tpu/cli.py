@@ -88,6 +88,15 @@ def _add_fused_infer_args(p: argparse.ArgumentParser):
                         "fills page*G recurrence rows (adds super-rungs; "
                         "default auto: 1 on CPU — small pages are "
                         "cache-bound faster there — 4 on accelerators)")
+    p.add_argument("--quant", choices=("off", "int8", "bf16"),
+                   default="off",
+                   help="quantized serving weights (ops/quantize.py): int8 "
+                        "stores GRU/dense matrices per-output-channel "
+                        "symmetric int8 (~3.9x fewer weight bytes), bf16 "
+                        "halves them; dequant happens at use inside the "
+                        "same fused executables, drift vs f32 is pinned "
+                        "by a parity envelope stored next to the "
+                        "checkpoint (violations raise; default off)")
 
 
 def _add_sparse_args(p: argparse.ArgumentParser, serving: bool = False):
@@ -645,7 +654,8 @@ def cmd_whatif(args) -> int:
         args.ckpt_dir, fused=not args.no_fused_infer,
         page_windows=args.infer_page_windows,
         coalesce_pages=args.infer_coalesce_pages,
-        mesh_config=_parse_mesh(args))
+        mesh_config=_parse_mesh(args),
+        quant=getattr(args, "quant", "off"))
     space = pred.space()
     if space is None:
         sys.exit("error: checkpoint has no feature space; cannot fit the "
@@ -817,7 +827,8 @@ def cmd_serve(args) -> int:
                 coalesce_groups=args.batch_coalesce_groups,
                 sparse_feed=args.sparse_feed,
                 sparse_nnz_cap=args.sparse_nnz_cap,
-                mesh_config=mesh_cfg)
+                mesh_config=mesh_cfg,
+                quant=args.quant)
         pred = Predictor.from_checkpoint(
             args.ckpt_dir, ladder=ladder, fused=not args.no_fused_infer,
             page_windows=args.infer_page_windows,
@@ -825,7 +836,8 @@ def cmd_serve(args) -> int:
             coalesce_groups=args.batch_coalesce_groups,
             sparse_feed=args.sparse_feed,
             sparse_nnz_cap=args.sparse_nnz_cap,
-            mesh_config=mesh_cfg)
+            mesh_config=mesh_cfg,
+            quant=args.quant)
         backend = f"checkpoint:{args.ckpt_dir}"
         if reloader is not None:
             backend += " (watching)"
@@ -836,7 +848,8 @@ def cmd_serve(args) -> int:
             args.artifact, ladder=ladder, fused=not args.no_fused_infer,
             page_windows=args.infer_page_windows,
             coalesce_pages=args.infer_coalesce_pages,
-            coalesce_groups=args.batch_coalesce_groups)
+            coalesce_groups=args.batch_coalesce_groups,
+            quant=args.quant)
         backend = f"artifact:{args.artifact}"
 
     # -- multi-replica routing front (serve/router.py) -------------------
@@ -870,7 +883,8 @@ def cmd_serve(args) -> int:
                                "page_windows": args.infer_page_windows,
                                "coalesce_pages": args.infer_coalesce_pages,
                                "coalesce_groups":
-                                   args.batch_coalesce_groups}}
+                                   args.batch_coalesce_groups,
+                               "quant": args.quant}}
             pred = ReplicaRouter.build_process(
                 spec, args.replicas, config=router_cfg, batching=batching)
         else:
@@ -993,7 +1007,8 @@ def _predictor(args):
         fused=not getattr(args, "no_fused_infer", False),
         page_windows=getattr(args, "infer_page_windows", None),
         coalesce_pages=getattr(args, "infer_coalesce_pages", None),
-        mesh_config=_parse_mesh(args))
+        mesh_config=_parse_mesh(args),
+        quant=getattr(args, "quant", "off"))
 
 
 def _serving_traffic(args, pred) -> np.ndarray:

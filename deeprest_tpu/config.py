@@ -344,8 +344,19 @@ class InferConfig:
     # entry paths remain the default and the parity spec.
     sparse_feed: bool = False
     sparse_nnz_cap: int = 64
+    # Quantized serving (ops/quantize.py, round 22): "int8" stores every
+    # GRU/dense weight matrix per-output-channel symmetric int8 and
+    # dequantizes at use inside the fused executables (~3.9x fewer weight
+    # bytes); "bf16" halves them.  Output drift vs the f32 reference is
+    # measured at quantize time and pinned as a parity envelope next to
+    # the checkpoint — a violating reload raises (QuantParityError).
+    quant: str = "off"
 
     def __post_init__(self):
+        if self.quant not in ("off", "int8", "bf16"):
+            raise ValueError(
+                f"InferConfig.quant={self.quant!r}: must be one of "
+                "'off', 'int8', 'bf16'")
         if not isinstance(self.sparse_nnz_cap, int) \
                 or isinstance(self.sparse_nnz_cap, bool) \
                 or self.sparse_nnz_cap < 1:

@@ -61,6 +61,23 @@ MASK_PARAM_NAMES = ("mask_w1", "mask_b1", "mask_w2", "mask_b2")
 MASKED_PARAM_NAMES = ("gru_fwd_w_ih", "gru_bwd_w_ih")
 
 
+def resolve_params(params):
+    """Weights-adapter hook (round 22): dequantize-at-use for a
+    quantized serving param tree (ops/quantize.quantize_params),
+    identity for f32/bf16 trees.
+
+    The jitted serving wrappers (serve/predictor.py) call this BEFORE
+    ``model.apply`` sees the tree: flax validates supplied param leaf
+    shapes against init, so int8+scale ``QuantTensor`` pairs must
+    resolve back to plain ``[.., K, C]`` arrays first.  The dequant
+    still runs ON DEVICE inside the calling executable (this is traced
+    code), through the one sanctioned site — ops/quantize.dequantize —
+    shared with the ops-level ``gru.resolve_weights`` hook."""
+    from deeprest_tpu.ops.quantize import dequantize_params
+
+    return dequantize_params(params)
+
+
 def feature_mask(params) -> jax.Array:
     """The learned soft feature mask ``[E, F]`` from the mask parameters.
 

@@ -66,6 +66,23 @@ class GRUParams(NamedTuple):
         return self.w_hh.shape[-2]
 
 
+def resolve_weights(params: GRUParams) -> GRUParams:
+    """Weights-adapter hook (round 22): dequantize-at-use for quantized
+    serving weights, identity otherwise.  Called once at the top of the
+    ``gru``/``bidirectional_gru`` entry points — the coalesced variants
+    delegate to them, so EVERY recurrence path (scan, pallas, coalesced,
+    bidirectional) shares the one sanctioned dequant site
+    (ops/quantize.dequantize); the widen+scale runs inside the calling
+    executable and XLA fuses it into the first projection dot."""
+    from deeprest_tpu.ops.quantize import QuantTensor, dequantize
+
+    if isinstance(params.w_ih, QuantTensor) \
+            or isinstance(params.w_hh, QuantTensor):
+        return params._replace(w_ih=dequantize(params.w_ih),
+                               w_hh=dequantize(params.w_hh))
+    return params
+
+
 def init_gru_params(
     key: jax.Array, num_experts: int, input_size: int, hidden_size: int,
     dtype=jnp.float32,
@@ -224,6 +241,7 @@ def gru(
 
     Returns: ``[E, B, T, H]`` hidden states.
     """
+    params = resolve_weights(params)
     e = params.w_ih.shape[0]
     b = x.shape[-3]
     if h0 is None:
@@ -403,6 +421,7 @@ def bidirectional_gru(
     each time-aligned with the input.  On the pallas path both directions
     run fused in one kernel invocation (see :func:`_bidir_pallas`).
     """
+    fwd, bwd = resolve_weights(fwd), resolve_weights(bwd)
     resolved = _resolve_backend(backend)
     if resolved != "scan" and BIDIR_FUSED:
         from deeprest_tpu.ops import pallas_gru

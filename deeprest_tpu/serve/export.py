@@ -30,6 +30,7 @@ import numpy as np
 from jax import export as jexport
 
 from deeprest_tpu.data.windows import MinMaxStats
+from deeprest_tpu.models.qrnn import resolve_params
 from deeprest_tpu.serve.batcher import BatchedBackendMixin
 from deeprest_tpu.serve.fused import FusedInferenceMixin
 from deeprest_tpu.serve.predictor import Predictor
@@ -52,9 +53,13 @@ def export_predictor(pred: Predictor, directory: str) -> str:
     (b,) = jexport.symbolic_shape("b")
     spec = jax.ShapeDtypeStruct(
         (b, pred.window_size, pred.feature_dim), jnp.float32)
+    # resolve_params: a quantized predictor's tree dequantizes at trace
+    # time, so the artifact bakes the quantized-then-dequantized values —
+    # the exported module reproduces the quantized numerics (and the
+    # manifest carries the mode + its measured parity envelope below).
     fn = jax.jit(lambda x: pred.model.apply(
         # graftlint: disable=JX001 -- deliberate: the artifact's whole point is baking the trained params into the serialized module as constants; bit parity vs the in-process path is pinned by tests/test_export_serve.py
-        {"params": pred.params}, x, deterministic=True))
+        {"params": resolve_params(pred.params)}, x, deterministic=True))
     exported = jexport.export(fn, platforms=_PLATFORMS)(spec)
     with open(os.path.join(directory, ARTIFACT_BLOB), "wb") as f:
         f.write(exported.serialize())
@@ -71,6 +76,12 @@ def export_predictor(pred: Predictor, directory: str) -> str:
         "space": pred.space_dict,
         "delta_mask": (np.asarray(pred.delta_mask, bool).tolist()
                        if pred.delta_mask is not None else None),
+        # quantized-serving provenance (round 22): the mode the baked
+        # weights were quantized at, plus the measured-at-quantize-time
+        # parity envelope — restoring at a DIFFERENT mode raises
+        # (ExportedPredictor.load), never silently serves other numerics
+        "quant": getattr(pred, "quant", "off"),
+        "quant_parity": getattr(pred, "parity_envelope", None),
     }
     with open(os.path.join(directory, ARTIFACT_MANIFEST), "w",
               encoding="utf-8") as f:
@@ -96,9 +107,23 @@ class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
                  fused: bool = True,
                  page_windows: int | None = None,
                  coalesce_pages: int | None = None,
-                 coalesce_groups: int = 1):
+                 coalesce_groups: int = 1,
+                 quant: str = "off"):
         if manifest.get("format") != _FORMAT:
             raise ValueError(f"unknown artifact format {manifest.get('format')!r}")
+        baked = str(manifest.get("quant", "off"))
+        if str(quant) != baked:
+            # A quantized artifact's weights are baked at export time; the
+            # caller cannot change the numerics here, only acknowledge
+            # them.  Refusing beats silently serving numerics the operator
+            # did not opt into (the parity envelope belongs to ``baked``).
+            raise ValueError(
+                f"artifact was exported at quant={baked!r} but load was "
+                f"asked for quant={quant!r}; pass --quant {baked} "
+                f"(ExportedPredictor.load(..., quant={baked!r})) to serve "
+                "it, or re-export at the mode you want")
+        self.quant = baked
+        self.parity_envelope = manifest.get("quant_parity")
         self._exported = exported
         self.manifest = manifest
         self.metric_names: list[str] = list(manifest["metric_names"])
@@ -126,7 +151,8 @@ class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
              fused: bool = True,
              page_windows: int | None = None,
              coalesce_pages: int | None = None,
-             coalesce_groups: int = 1) -> "ExportedPredictor":
+             coalesce_groups: int = 1,
+             quant: str = "off") -> "ExportedPredictor":
         with open(os.path.join(directory, ARTIFACT_MANIFEST),
                   encoding="utf-8") as f:
             manifest = json.load(f)
@@ -134,7 +160,7 @@ class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
             exported = jexport.deserialize(f.read())
         return cls(exported, manifest, ladder=ladder, fused=fused,
                    page_windows=page_windows, coalesce_pages=coalesce_pages,
-                   coalesce_groups=coalesce_groups)
+                   coalesce_groups=coalesce_groups, quant=quant)
 
     def jit_cache_size(self) -> int | None:
         """Fused-pipeline executable count (the artifact's own symbolic-

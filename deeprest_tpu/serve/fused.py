@@ -113,8 +113,18 @@ class FusedRolledEngine:
                  page_windows: int | None = None,
                  coalesce_pages: int | None = None,
                  sparse_nnz_cap: int | None = None,
-                 feature_dim: int | None = None):
+                 feature_dim: int | None = None,
+                 quant: str = "off"):
         import jax
+
+        # Quantized serving (round 22): the engine itself needs no quant
+        # branch — ``params`` may be a quantized tree (ops/quantize.py)
+        # and the owning backend's apply_fn dequantizes at use inside
+        # the SAME jitted executables, so the per-rung executable count
+        # is identical across modes.  The mode is recorded here so
+        # ``stats()`` (the /healthz fused_infer block the flat-compile
+        # probes read) names which mode its counters were measured at.
+        self.quant = str(quant)
 
         rung_set = {int(r) for r in rungs}
         if page_windows is not None:
@@ -453,6 +463,7 @@ class FusedRolledEngine:
                 "max_dispatch_rows": self._max_dispatch_rows,
                 "dispatched_rungs": sorted(self._compiled),
                 "sparse_nnz_cap": self._sparse_nnz_cap,
+                "quant": self.quant,
             }
 
     def cache_size(self) -> int | None:
@@ -496,7 +507,8 @@ class FusedInferenceMixin:
             coalesce_pages=coalesce_pages,
             sparse_nnz_cap=sparse_nnz_cap,
             feature_dim=(self.feature_dim if sparse_nnz_cap is not None
-                         else None))
+                         else None),
+            quant=getattr(self, "quant", "off"))
 
     @property
     def fused(self) -> FusedRolledEngine | None:

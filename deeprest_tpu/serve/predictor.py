@@ -15,7 +15,8 @@ import numpy as np
 
 from deeprest_tpu.config import Config, ModelConfig
 from deeprest_tpu.data.windows import MinMaxStats
-from deeprest_tpu.models.qrnn import QuantileGRU
+from deeprest_tpu.models.qrnn import QuantileGRU, resolve_params
+from deeprest_tpu.ops import quantize as quant_ops
 from deeprest_tpu.serve.batcher import BatchedBackendMixin
 from deeprest_tpu.serve.fused import FusedInferenceMixin
 
@@ -119,7 +120,20 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                  coalesce_pages: int | None = None,
                  coalesce_groups: int = 1,
                  sparse_feed: bool = False,
-                 sparse_nnz_cap: int = 64):
+                 sparse_nnz_cap: int = 64,
+                 quant: str = "off",
+                 quant_budget: dict | None = None):
+        # Quantized serving (round 22, ops/quantize.py): weight leaves
+        # stored int8 (+f32 scales) or bf16, dequantized at use INSIDE
+        # the jitted wrappers below via models.qrnn.resolve_params — the
+        # one sanctioned site, on device, fused into the executables.
+        if quant not in quant_ops.QUANT_MODES:
+            raise ValueError(
+                f"quant mode {quant!r} not in {quant_ops.QUANT_MODES}")
+        self.quant = quant
+        ref_params = params
+        if quant != "off":
+            params = quant_ops.quantize_params(params, quant)
         self.params = params
         self.model = QuantileGRU(config=model_config)
         self.x_stats = x_stats
@@ -134,8 +148,12 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
         # them back to levels.  None (pre-delta checkpoints): no-op.
         self.delta_mask = (np.asarray(delta_mask, bool)
                            if delta_mask is not None else None)
+        # resolve_params is the weights-adapter: identity trace for f32
+        # trees, on-device dequant for quantized ones — ONE apply path,
+        # so the executable count stays flat across quant modes.
         self._apply = jax.jit(
-            lambda p, x: self.model.apply({"params": p}, x, deterministic=True)
+            lambda p, x: self.model.apply({"params": resolve_params(p)},
+                                          x, deterministic=True)
         )
         # Sparse-first serving feed (InferConfig.sparse_feed): a second
         # jitted apply taking RAW padded-COO windows plus the staged
@@ -158,7 +176,7 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                 np.asarray(x_stats.range, np.float32).reshape(-1))
             self._apply_sparse = jax.jit(
                 lambda p, c, v, mn, rg: self.model.apply(
-                    {"params": p},
+                    {"params": resolve_params(p)},
                     normalize_minmax(densify_coo(c, v, feat), mn, rg),
                     deterministic=True))
             apply_sparse = lambda c, v: self._apply_sparse(
@@ -182,6 +200,52 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             coalesce_pages=coalesce_pages,
             sparse_nnz_cap=(self.sparse_nnz_cap if self.sparse_feed
                             else None))
+        # Parity is a product contract: measure the per-(metric,
+        # quantile) envelope vs the f32 reference at quantize time, and
+        # fail LOUDLY if a stored budget (the checkpoint's pinned
+        # envelope) is exceeded — a quantized predictor never serves
+        # outside the parity its checkpoint recorded.
+        self.parity_envelope = None
+        if quant != "off":
+            self.parity_envelope = self._measure_parity(
+                ref_params, quant_budget)
+
+    def _measure_parity(self, ref_params, budget: dict | None) -> dict:
+        """Quantize-time parity measurement on the deterministic probe
+        batch (ops/quantize.probe_batch): quantized apply vs the f32
+        reference, reduced to the per-(metric, quantile) envelope.
+
+        Runs through a throwaway jitted apply, NOT ``self._apply``, so
+        the probe never perturbs the serving executable count the
+        zero-post-warmup-compiles probes pin.  With a ``budget`` (the
+        envelope stored next to the checkpoint) any violated cell
+        raises — the loud gate."""
+        probe = quant_ops.probe_batch(self.window_size,
+                                      self.model.config.feature_dim)
+        x = jnp.asarray(probe)
+        apply_once = jax.jit(
+            lambda p, xx: self.model.apply(
+                {"params": resolve_params(p)}, xx, deterministic=True))
+        measured = quant_ops.parity_envelope(
+            apply_once(ref_params, x), apply_once(self.params, x),
+            self.metric_names, self.model.config.quantiles)
+        envelope = {
+            "mode": self.quant,
+            "measured": measured,
+            "budget": (dict(budget["budget"]) if budget is not None
+                       else quant_ops.budget_from_measured(measured)),
+        }
+        if budget is not None:
+            violations = quant_ops.check_envelope(measured,
+                                                  envelope["budget"])
+            if violations:
+                raise quant_ops.QuantParityError(
+                    f"quantized ({self.quant}) predictions exceed the "
+                    "stored parity envelope: "
+                    + "; ".join(violations[:8])
+                    + (f" (+{len(violations) - 8} more)"
+                       if len(violations) > 8 else ""))
+        return envelope
 
     def params_digest(self) -> str:
         """Stable fingerprint of the served params — the ``params_hash``
@@ -194,6 +258,12 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             import hashlib
 
             h = hashlib.sha1()
+            # Quant mode enters the digest: a surface built at int8 must
+            # never be served by (or to) an f32 predictor — the quant
+            # mode is part of the cache-key identity, explicitly, not
+            # just via the (already different) quantized leaf bytes.
+            if self.quant != "off":
+                h.update(self.quant.encode())
             for leaf in jax.tree_util.tree_leaves(self.params):
                 # graftlint: disable=JX003 -- host data: one-time per-checkpoint fingerprint, cached on the instance
                 h.update(np.asarray(leaf).tobytes())
@@ -231,6 +301,10 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             "ladder_rungs": len(self.ladder.ladder),
             "fused_rungs": (len(self._fused.rungs)
                             if self._fused is not None else 0),
+            # the quant mode these executables were built at — the
+            # flat-executable probes compare counts ACROSS modes, so the
+            # breakdown must name which mode it counted
+            "quant": self.quant,
         }
 
     @property
@@ -266,8 +340,17 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                         coalesce_groups: int = 1,
                         sparse_feed: bool = False,
                         sparse_nnz_cap: int = 64,
-                        mesh_config=None) -> "Predictor":
+                        mesh_config=None,
+                        quant: str = "off") -> "Predictor":
         """Restore params + host stats written by Trainer.save().
+
+        ``quant`` ({'off','int8','bf16'}, ops/quantize.py): quantize the
+        restored weights for serving.  The per-(metric, quantile) parity
+        envelope vs the f32 reference is measured at quantize time and
+        stored NEXT TO the checkpoint (``quant_parity_<mode>.json``); on
+        every later load at the same mode the re-measured parity is
+        checked against that stored budget and a violation raises — the
+        envelope is a product contract, not a hope.
 
         With ``config=None`` the architecture comes wholesale from the
         checkpoint sidecar (all checkpoints written by Trainer.save carry
@@ -293,13 +376,19 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
 
         with obs_spans.RECORDER.span("predictor.load",
                                      component="deeprest-predictor") as sp:
-            sp.tag(directory=directory, step=step)
+            sp.tag(directory=directory, step=step, quant=quant)
             return cls._from_checkpoint_inner(
                 directory, config, step, ladder, fused, page_windows,
                 coalesce_pages, coalesce_groups, sparse_feed,
                 sparse_nnz_cap, mesh_config,
                 make_mesh, latest_step, load_sidecar, restore_checkpoint,
-                Trainer)
+                Trainer, quant)
+
+    @staticmethod
+    def _quant_envelope_path(directory: str, quant: str) -> str:
+        import os
+
+        return os.path.join(directory, f"quant_parity_{quant}.json")
 
     @classmethod
     def _from_checkpoint_inner(cls, directory, config, step, ladder, fused,
@@ -307,7 +396,8 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                                coalesce_groups, sparse_feed,
                                sparse_nnz_cap, mesh_config, make_mesh,
                                latest_step, load_sidecar,
-                               restore_checkpoint, Trainer) -> "Predictor":
+                               restore_checkpoint, Trainer,
+                               quant: str = "off") -> "Predictor":
         if step is None:
             step = latest_step(directory)
             if step is None:
@@ -332,7 +422,20 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             np.zeros((1, extra["window_size"], extra["feature_dim"]), np.float32)
         )
         state, _ = restore_checkpoint(directory, target, step=step)
-        return cls(
+        # The stored parity envelope rides next to the checkpoint: first
+        # quantized load at a mode measures and pins it; every later
+        # load re-measures and the budget gate raises on violation
+        # (Predictor._measure_parity).
+        quant_budget = None
+        if quant != "off":
+            import json
+            import os
+
+            env_path = cls._quant_envelope_path(directory, quant)
+            if os.path.exists(env_path):
+                with open(env_path, encoding="utf-8") as fh:
+                    quant_budget = json.load(fh)
+        predictor = cls(
             params=state.params,
             model_config=trainer.model_config,
             x_stats=MinMaxStats.from_dict(extra["x_stats"]),
@@ -348,7 +451,17 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
             coalesce_groups=coalesce_groups,
             sparse_feed=sparse_feed,
             sparse_nnz_cap=sparse_nnz_cap,
+            quant=quant,
+            quant_budget=quant_budget,
         )
+        if quant != "off" and quant_budget is None:
+            import json
+
+            env_path = cls._quant_envelope_path(directory, quant)
+            with open(env_path, "w", encoding="utf-8") as fh:
+                json.dump({"step": step, **predictor.parity_envelope},
+                          fh, indent=2, sort_keys=True)
+        return predictor
 
     def space(self):
         """The training corpus's CallPathSpace (column-exact featurization

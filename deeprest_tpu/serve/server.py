@@ -84,7 +84,8 @@ class CheckpointReloader:
                  coalesce_groups: int = 1,
                  sparse_feed: bool = False,
                  sparse_nnz_cap: int = 64,
-                 mesh_config=None):
+                 mesh_config=None,
+                 quant: str = "off"):
         from deeprest_tpu.train.checkpoint import latest_step
 
         self.ckpt_dir = ckpt_dir
@@ -97,6 +98,8 @@ class CheckpointReloader:
         self.sparse_feed = sparse_feed   # ... and the sparse-feed plane
         self.sparse_nnz_cap = sparse_nnz_cap
         self.mesh_config = mesh_config   # ... and the serving mesh (TP)
+        self.quant = quant        # ... and the quant mode (parity-gated
+        #                           per reload against the stored envelope)
         self._last_step = latest_step(ckpt_dir)
         self._next_check = 0.0
         self._pending = None       # loaded Predictor awaiting pickup
@@ -146,13 +149,21 @@ class CheckpointReloader:
                 coalesce_groups=self.coalesce_groups,
                 sparse_feed=self.sparse_feed,
                 sparse_nnz_cap=self.sparse_nnz_cap,
-                mesh_config=self.mesh_config)
+                mesh_config=self.mesh_config,
+                quant=self.quant)
         except Exception as e:
             # Mid-write/pruned steps are expected (FileNotFoundError/
             # ValueError); anything else is logged but must never wedge
             # the reloader — _loading MUST be cleared or the server would
-            # silently never reload again.
-            if not isinstance(e, (FileNotFoundError, ValueError)):
+            # silently never reload again.  A violated quant parity
+            # envelope is a ValueError subclass but is NEVER benign: the
+            # new step's quantized weights fall outside the pinned
+            # budget, the server keeps serving the old step, and the
+            # operator must hear about it.
+            from deeprest_tpu.ops.quantize import QuantParityError
+
+            if isinstance(e, QuantParityError) or not isinstance(
+                    e, (FileNotFoundError, ValueError)):
                 import sys
 
                 print(f"checkpoint reload of step {step} failed: {e!r}",
@@ -518,6 +529,18 @@ class PredictionService:
             # (additive key; the wire protocol's existing fields are
             # untouched)
             out["fused_infer"] = fused.stats()
+        # quantized-serving surface (additive key): the active quant
+        # mode plus the stored parity envelope's worst measured cell —
+        # operators see at a glance whether this plane serves narrow
+        # weights and how far from the f32 reference it sits
+        quant = getattr(pred, "quant", "off")
+        envelope = getattr(pred, "parity_envelope", None)
+        out["quant"] = {"mode": quant}
+        if envelope is not None:
+            measured = envelope.get("measured", {})
+            out["quant"]["parity_max"] = (max(measured.values())
+                                          if measured else None)
+            out["quant"]["parity_cells"] = len(measured)
         # span-recorder health (additive key): enabled flag, ring
         # retention, eviction pressure — the JSON twin of the /metrics
         # deeprest_obs_* gauges
@@ -552,7 +575,20 @@ class PredictionService:
                 "no quality monitor attached: start the server with "
                 "--verdict-raw <collector jsonl> (or attach_quality) to "
                 "enable the streaming verdict surface", status=503)
-        return quality.verdicts()
+        out = quality.verdicts()
+        # The quant parity envelope joins the verdict surface (additive
+        # key): it is a model-quality contract — per-(metric, quantile)
+        # measured deviation vs the f32 reference and the stored budget
+        # it is gated against at every (re)load.
+        pred, _, _, _ = self._snapshot()
+        envelope = getattr(pred, "parity_envelope", None)
+        if envelope is not None:
+            out["quant_parity"] = {
+                "mode": getattr(pred, "quant", "off"),
+                "measured": dict(envelope.get("measured", {})),
+                "budget": dict(envelope.get("budget", {})),
+            }
+        return out
 
     def meta(self) -> dict:
         pred, whatif, _, _ = self._snapshot()

@@ -407,11 +407,19 @@ class CapacitySurfaceManager:
         (:meth:`Predictor.params_digest`); backends without one (the
         replica router) key on the invalidation epoch + object identity,
         which the reload bracket bumps — staleness is structurally
-        impossible either way."""
+        impossible either way.
+
+        The serving quant mode is recorded IN the key (round 22): a
+        surface built from int8 predictions carries that mode's parity
+        envelope, so an f32 (or bf16) predictor must never answer from
+        it — the digest already differs leaf-wise, the explicit suffix
+        makes the contract auditable in the key itself."""
+        quant = getattr(predictor, "quant", "off")
+        suffix = "" if quant == "off" else f":{quant}"
         digest = getattr(predictor, "params_digest", None)
         if callable(digest):
             try:
-                return str(digest())
+                return str(digest()) + suffix
             # graftlint: disable=EX003 -- designed fallback: an undigestable backend degrades to epoch keying, which is strictly safe (reload bumps the epoch)
             except Exception:
                 pass

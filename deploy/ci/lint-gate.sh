@@ -5,8 +5,9 @@
 #   1. graftlint over the whole repo — findings scoped to the files the
 #      PR changed (the whole project is still parsed so the call graph
 #      and the graftflow value-flow engine keep their interprocedural
-#      context) — emitted as SARIF 2.1.0 (lint.sarif) so the review
-#      system annotates findings inline on the diff.  The warm
+#      context, incl. QT001's int8-escape tracking across call chains
+#      into ops//serve/) — emitted as SARIF 2.1.0 (lint.sarif) so the
+#      review system annotates findings inline on the diff.  The warm
 #      .graftlint_cache/ makes the re-runs on push cheap; CI runners
 #      that persist a workspace get the same win.
 #   2. The tier-1 test suite (the exact ROADMAP.md command): the lint

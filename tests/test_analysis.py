@@ -1074,7 +1074,7 @@ def test_cli_list_rules(capsys):
 def test_rule_registry_complete():
     rules = all_rules()
     assert {"JX001", "JX002", "JX003", "JX004", "JX005",
-            "JX006", "JX007",
+            "JX006", "JX007", "QT001",
             "TH001", "TH002", "TH003", "TH004",
             "HY001", "HY002", "OB001", "DN001", "DN002",
             "RS001", "RS002", "RS003", "RS004",
@@ -2408,6 +2408,113 @@ def drain(pages):
 
 
 # ---------------------------------------------------------------------------
+# QT001: int8 quantized weight promoted to float outside ops/quantize.py
+
+
+QT001_BAD = """
+import jax.numpy as jnp
+
+def apply_weight(x):
+    w = jnp.zeros((4, 8), dtype=jnp.int8)
+    return jnp.dot(x, w.astype(jnp.float32))
+"""
+
+QT001_GOOD = """
+import jax.numpy as jnp
+
+def apply_weight(x):
+    w = jnp.zeros((4, 8), dtype=jnp.float32)
+    return jnp.dot(x, w)
+"""
+
+
+def test_qt001_pair():
+    assert_pair("QT001", QT001_BAD, QT001_GOOD, rel="ops/mod.py")
+
+
+def test_qt001_matmul_consumer_fires():
+    # no astype, no BinOp — handing the raw int8 operand to the
+    # matmul family must fire at the consumer (XLA promotes inside
+    # the op with the scale never applied)
+    bad = """
+import jax.numpy as jnp
+
+def apply_weight(x):
+    w = jnp.zeros((4, 8), dtype=jnp.int8)
+    return jnp.dot(x, w)
+"""
+    fired = findings_for("QT001", bad, rel="serve/mod.py")
+    assert fired and "dot" in fired[0].message
+
+
+def test_qt001_binop_promotion_fires():
+    bad = """
+import jax.numpy as jnp
+
+def scale_weight(x):
+    w = jnp.zeros((4, 8), dtype=jnp.int8)
+    return w * 0.5 + x
+"""
+    assert findings_for("QT001", bad, rel="ops/mod.py")
+
+
+QT001_INTERPROC_BAD = {
+    "serve/engine.py": """
+from ops.helpers import apply_weight
+import jax.numpy as jnp
+
+def serve(x):
+    w = jnp.zeros((4, 8), dtype=jnp.int8)
+    return apply_weight(w, x)
+""",
+    "ops/helpers.py": """
+import jax.numpy as jnp
+
+def apply_weight(w, x):
+    return jnp.dot(x, w.astype(jnp.float32))
+""",
+}
+
+
+def test_qt001_interprocedural_fires_at_origin():
+    # the int8 tensor is born in serve/, the raw cast happens in a
+    # helper — the finding lands where the scale was dropped, along
+    # ANY call chain into ops//serve/ (the ISSUE's contract)
+    result = lint_sources(QT001_INTERPROC_BAD,
+                          rules=[all_rules()["QT001"]])
+    assert [(f.path, f.line) for f in result.findings] == [
+        ("ops/helpers.py", 5)]
+
+
+def test_qt001_sanctioned_dequant_site_is_silent():
+    # the IDENTICAL cast inside ops/quantize.py is the sanctioned
+    # dequant helper — the one place i8 -> f32 is the whole point
+    sources = {
+        "serve/engine.py": """
+from ops.quantize import dequantize
+import jax.numpy as jnp
+
+def serve(x):
+    w = jnp.zeros((4, 8), dtype=jnp.int8)
+    return dequantize(w, x)
+""",
+        "ops/quantize.py": """
+import jax.numpy as jnp
+
+def dequantize(w, x):
+    return jnp.dot(x, w.astype(jnp.float32) * 0.01)
+""",
+    }
+    result = lint_sources(sources, rules=[all_rules()["QT001"]])
+    assert not result.findings
+
+
+def test_qt001_outside_hot_dirs_is_silent():
+    # int8 escapes in fixture/tooling files are not weight data
+    assert not findings_for("QT001", QT001_BAD, rel="tools/mod.py")
+
+
+# ---------------------------------------------------------------------------
 # DN001-on-graftflow: pre-migration verdicts, bit for bit
 
 
@@ -2433,6 +2540,10 @@ def test_dn001_verdicts_unchanged_after_dataflow_migration():
             ("obs/quality.py", 6, 17, "DN001", DN001_PIN_MSG)],
         ("obs/quality.py", DN001_OBS_GOOD): [],
         ("ops/densify.py", DN001_OBS_BAD): [],
+        # round 22: quantization walks every weight tensor per reload —
+        # ops/quantize.py joins the sparse-first watchlist
+        ("ops/quantize.py", DN001_BAD): [
+            ("ops/quantize.py", 5, 8, "DN001", DN001_PIN_MSG)],
     }
     for (rel, src), want in expected.items():
         result = lint_sources({rel: src}, rules=[all_rules()["DN001"]])
