@@ -68,6 +68,14 @@
 #                         post-warmup) — refreshes benchmarks/
 #                         quant_bench.json; the on-chip bandwidth win
 #                         rides benchmarks/tpu_queue.sh quant_serve
+#   make fleet-bench      the multi-tenant serving gate (100 apps, one
+#                         executable plane: zero post-warmup compiles,
+#                         bit-exact LRU spill/restore, byte-checked
+#                         tenant isolation, AOT cold start beating
+#                         compile-from-scratch) — refreshes benchmarks/
+#                         fleet_bench.json; the on-chip cold-start and
+#                         restore numbers ride benchmarks/tpu_queue.sh
+#                         fleet_serve
 
 PYTHON ?= python
 
@@ -117,6 +125,9 @@ whatif-bench:
 quant-bench:
 	$(PYTHON) benchmarks/quant_bench.py --out benchmarks/quant_bench.json
 
+fleet-bench:
+	$(PYTHON) benchmarks/fleet_bench.py --out benchmarks/fleet_bench.json
+
 .PHONY: lint lint-changed lint-fix lint-sarif lint-gate native tsan \
 	bench-multichip serve-bench-replicas obs-bench tenk-bench \
-	chaos-bench drift-bench whatif-bench quant-bench
+	chaos-bench drift-bench whatif-bench quant-bench fleet-bench

@@ -807,6 +807,25 @@ def main() -> None:
     except Exception as exc:
         print(f"bench: quant measurement failed: {exc}", file=sys.stderr)
 
+    # Fleet-tier headline (schema v14, NEW keys): apps served through
+    # ONE executable plane, the AOT cold-start, and the LRU spill->
+    # restore cost, read from the committed full-run dossier
+    # (benchmarks/fleet_bench.json — `make fleet-bench` refreshes it;
+    # the dossier's own gates pin zero post-warmup compiles, bit-exact
+    # spill/restore, byte-checked tenant isolation, and AOT beating
+    # compile-from-scratch).  Committed-artifact read, not a child run:
+    # the 100-app storm is its own bench's wall-time budget.
+    fleet_apps = fleet_cold = fleet_restore = None
+    try:
+        with open(os.path.join(REPO, "benchmarks", "fleet_bench.json"),
+                  encoding="utf-8") as f:
+            _fleet = json.load(f)
+            fleet_apps = int(_fleet["ledger"]["apps"])
+            fleet_cold = float(_fleet["aot"]["aot_cold_start_ms"])
+            fleet_restore = float(_fleet["churn"]["restore_ms_median"])
+    except Exception:
+        pass
+
     # Elastic-remesh recovery headline (schema v11, NEW key): the worst
     # detect->rebuild->restore wall time across the committed chaos
     # storm's elastic arm (benchmarks/chaos_bench.json — `make
@@ -825,6 +844,14 @@ def main() -> None:
 
     perf = _mfu_block(measured, F)
     result = {
+        # v14: the fleet tier adds fleet_apps (synthetic apps served
+        # through ONE fused-executable plane in the committed
+        # benchmarks/fleet_bench.json full run), fleet_cold_start_ms
+        # (AOT deserialize + first dispatch on a fresh engine, vs
+        # compile-from-scratch in the dossier), and
+        # fleet_spill_restore_ms (median host->device restore of an
+        # LRU-evicted tenant's weight tree during the churn storm) —
+        # NEW keys only; every v13 key keeps its meaning.
         # v13: the quantized serving tier adds quant_weight_bytes (the
         # int8 serving weight-tree bytes on the quick world —
         # benchmarks/quant_bench.py; the committed quant_bench.json
@@ -889,7 +916,7 @@ def main() -> None:
         # (new key); host_feed_steps_per_sec regained its pre-round-5
         # meaning (fresh windows shipped every step); vs_baseline moved
         # under footnotes (round-5 ADVICE low #1 / VERDICT weak #5).
-        "schema_version": 13,
+        "schema_version": 14,
         "metric": "train_steps_per_sec",
         "value": round(jax_sps, 3),
         "unit": f"steps/s ({platform}; B={B} T={T} F={F} E={E} H={H}, "
@@ -955,6 +982,12 @@ def main() -> None:
         result["quant_weight_bytes"] = quant_bytes
     if quant_parity is not None:
         result["quant_parity_max"] = quant_parity
+    if fleet_apps is not None:
+        result["fleet_apps"] = fleet_apps
+    if fleet_cold is not None:
+        result["fleet_cold_start_ms"] = fleet_cold
+    if fleet_restore is not None:
+        result["fleet_spill_restore_ms"] = fleet_restore
     if tpu_error is not None:
         result["tpu_error"] = tpu_error[:400]
     if measured.get("rnn_backend_fallback"):

@@ -161,6 +161,17 @@ step whatif_surface 1200 env JAX_PLATFORMS=tpu python \
 # this artifact, never from the CPU one.
 step quant_serve 1200 env JAX_PLATFORMS=tpu python \
   benchmarks/quant_bench.py --out benchmarks/quant_bench_tpu.json
+# Fleet tier on-chip (round 23): the committed CPU fleet_bench.json
+# proves the gates (zero post-warmup compiles across 100 apps, bit-exact
+# spill/restore, byte-checked isolation) but footnotes the AOT speedup —
+# XLA:CPU compiles these graphs in fractions of a second, while TPU
+# compiles of the same ladder take orders of magnitude longer and
+# deserialization cost barely moves.  The on-chip aot_cold_start_ms vs
+# compile_cold_start_ms gap and the host->HBM restore_ms_median are the
+# numbers the fleet tier actually sells; only ever state the speedup
+# from this artifact, never from the CPU one.
+step fleet_serve 1500 env JAX_PLATFORMS=tpu python \
+  benchmarks/fleet_bench.py --out benchmarks/fleet_bench_tpu.json
 # pallas-under-GSPMD on the real chip (VERDICT r3 weak #5): the flagship
 # train step through the sharded Trainer path (1-chip mesh exercises the
 # same jit + sharding + kernel composition), honest readback sync.

@@ -263,7 +263,7 @@ def all_rules() -> dict[str, Rule]:
 
     for pack in ("rules_jax", "rules_threading", "rules_hygiene",
                  "rules_obs", "rules_data", "rules_lifecycle",
-                 "rules_exceptions"):
+                 "rules_exceptions", "rules_fleet"):
         importlib.import_module(f"deeprest_tpu.analysis.{pack}")
     return dict(_REGISTRY)
 

@@ -699,18 +699,24 @@ def cmd_whatif(args) -> int:
 
 
 def cmd_export(args) -> int:
-    """Checkpoint → portable inference artifact (serve/export.py)."""
-    from deeprest_tpu.serve.export import export_predictor
+    """Checkpoint → portable inference artifact (serve/export.py), plus
+    optional AOT executable sidecars next to the checkpoint (--aot)."""
+    from deeprest_tpu.serve.export import export_aot_sidecar, export_predictor
     from deeprest_tpu.serve.predictor import Predictor
 
     pred = Predictor.from_checkpoint(args.ckpt_dir)
     out = export_predictor(pred, args.out)
-    print(json.dumps({
+    result = {
         "out": out,
         "metrics": len(pred.metric_names),
         "feature_dim": pred.feature_dim,
         "window_size": pred.window_size,
-    }))
+    }
+    if args.aot:
+        # fleet cold-start artifacts (serve/aot.py): pool admission of
+        # this checkpoint becomes a deserialize, not a compile
+        result["aot"] = export_aot_sidecar(pred, args.ckpt_dir)
+    print(json.dumps(result))
     return 0
 
 
@@ -853,6 +859,7 @@ def cmd_serve(args) -> int:
         backend = f"artifact:{args.artifact}"
 
     # -- multi-replica routing front (serve/router.py) -------------------
+    base_pred = pred           # pre-router reference: the fleet template
     autoscaler = None
     if args.replicas > 1 or args.admission_depth or args.tenant_weights:
         from deeprest_tpu.serve.router import ReplicaRouter, RouterConfig
@@ -907,6 +914,58 @@ def cmd_serve(args) -> int:
         sys.exit("error: --autoscale needs --replicas > 1 (the router is "
                  "the autoscaler's actuator)")
 
+    # -- fleet tier (serve/fleet.py): M tenants on this plane ------------
+    fleet_pool = None
+    if args.fleet:
+        from deeprest_tpu.config import FleetConfig, QualityConfig
+        from deeprest_tpu.serve.fleet import PredictorPool
+        from deeprest_tpu.serve.predictor import Predictor
+
+        if not args.ckpt_dir:
+            sys.exit("error: --fleet needs --ckpt-dir (tenant pools hold "
+                     "Predictor params; artifacts bake theirs in)")
+        if args.replica_mode == "process" and args.replicas > 1:
+            sys.exit("error: --fleet needs --replica-mode=thread (the "
+                     "per-request backend override would re-ship tenant "
+                     "params over the worker pipe)")
+        try:
+            fleet_cfg = FleetConfig(
+                enabled=True, hbm_budget=args.fleet_hbm_budget,
+                aot=not args.no_fleet_aot,
+                top_k_tenants=args.fleet_top_k,
+                quality=not args.no_fleet_quality)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        fleet_pool = PredictorPool(
+            hbm_budget=fleet_cfg.hbm_budget, aot=fleet_cfg.aot,
+            quality_config=(QualityConfig(enabled=True)
+                            if fleet_cfg.quality else None),
+            top_k_tenants=fleet_cfg.top_k_tenants)
+        # the serving backend is the default tenant AND the executable
+        # template; its AOT sidecar (deeprest export --aot) warms the
+        # whole plane — later tenants adopt, never compile
+        fleet_pool.admit("default", base_pred,
+                         checkpoint_path=args.ckpt_dir)
+        for spec_item in args.fleet:
+            name, _, ckpt = spec_item.partition("=")
+            if not name.strip() or not ckpt.strip():
+                sys.exit(f"error: bad --fleet entry {spec_item!r} "
+                         "(want tenant=checkpoint_dir)")
+            tenant_pred = Predictor.from_checkpoint(
+                ckpt.strip(), ladder=ladder,
+                fused=not args.no_fused_infer,
+                page_windows=args.infer_page_windows,
+                coalesce_pages=args.infer_coalesce_pages,
+                coalesce_groups=args.batch_coalesce_groups,
+                sparse_feed=args.sparse_feed,
+                sparse_nnz_cap=args.sparse_nnz_cap,
+                quant=args.quant)
+            try:
+                fleet_pool.admit(name.strip(), tenant_pred,
+                                 checkpoint_path=ckpt.strip())
+            except ValueError as e:
+                sys.exit(f"error: {e}")
+
     synthesizer = None
     if args.raw:
         from deeprest_tpu.data.synthesize import TraceSynthesizer
@@ -940,6 +999,8 @@ def cmd_serve(args) -> int:
     service = PredictionService(pred, synthesizer, backend=backend,
                                 reloader=reloader, batching=batching,
                                 surface=surface_cfg)
+    if fleet_pool is not None:
+        service.attach_fleet(fleet_pool)
     if args.verdict_raw:
         from deeprest_tpu.config import QualityConfig
         from deeprest_tpu.obs.quality import QualityMonitor
@@ -971,6 +1032,10 @@ def cmd_serve(args) -> int:
                                    "max_bytes": surface_cfg.max_bytes}
                                   if surface_cfg is not None else None),
                       "replicas": args.replicas,
+                      "fleet": ({"tenants": fleet_pool.tenants(),
+                                 "hbm_budget": fleet_pool.hbm_budget,
+                                 "aot": fleet_pool.stats()["aot"]}
+                                if fleet_pool is not None else None),
                       "autoscale": autoscaler is not None,
                       "verdict": ({"raw": args.verdict_raw,
                                    "sweep_every": args.verdict_sweep_every}
@@ -1583,6 +1648,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "(jax.export StableHLO + JSON manifest)")
     p.add_argument("--ckpt-dir", required=True)
     p.add_argument("--out", required=True, help="artifact directory")
+    p.add_argument("--aot", action="store_true",
+                   help="also compile + serialize the fused serving "
+                        "executables next to the checkpoint "
+                        "(<ckpt>/aot/, serve/aot.py) so fleet pool "
+                        "admission deserializes instead of compiling — "
+                        "platform-exact: export on the platform that "
+                        "will serve")
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("serve",
@@ -1740,6 +1812,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="build cache-miss surfaces inline instead of on a "
                         "background warm thread (deterministic tests/"
                         "benches; first query pays the build)")
+    p.add_argument("--fleet", action="append", default=None,
+                   metavar="TENANT=CKPT_DIR",
+                   help="admit another tenant application to this plane "
+                        "(repeatable; serve/fleet.py): X-Tenant then "
+                        "selects the MODEL, all tenants share one "
+                        "compiled executable set, and --ckpt-dir serves "
+                        "as the 'default' tenant and executable template")
+    p.add_argument("--fleet-hbm-budget", type=int, default=4, metavar="N",
+                   help="max tenants with device-resident params (LRU; "
+                        "evicted tenants spill to host memory and "
+                        "restore with one device_put — never a disk "
+                        "read or a compile)")
+    p.add_argument("--no-fleet-aot", action="store_true",
+                   help="skip loading AOT executable sidecars "
+                        "(<ckpt>/aot/, written by deeprest export "
+                        "--aot) at pool admission; rungs then compile "
+                        "lazily on first dispatch")
+    p.add_argument("--fleet-top-k", type=int, default=8, metavar="K",
+                   help="per-tenant observability cardinality bound: "
+                        "top-K tenants by serve count get their own "
+                        "/metrics labels and /healthz rows, the rest "
+                        "roll up under __other__")
+    p.add_argument("--no-fleet-quality", action="store_true",
+                   help="skip the per-tenant QualityMonitor (GET "
+                        "/v1/verdict then 503s for fleet tenants)")
     _add_fused_infer_args(p)
     _add_sparse_args(p, serving=True)
     _add_mesh_arg(p, serving=True)

@@ -526,6 +526,36 @@ class SurfaceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet tier (deeprest_tpu/serve/fleet.py — ROADMAP item 3): M
+    tenant applications on one serving plane through a checkpoint-keyed
+    predictor pool.
+
+    ``hbm_budget`` bounds how many tenants' params stay device-resident
+    (the LRU working set — evicted tenants spill to host memory and
+    restore with one ``device_put``); ``aot`` loads serialized
+    executables at admission (serve/aot.py) so a tenant's cold start is
+    a deserialize, not a compile; ``top_k_tenants`` bounds per-tenant
+    observability cardinality (/metrics labels, /healthz maps — the
+    rest rolls up under ``__other__``); ``quality`` attaches one
+    QualityMonitor per pool entry (per-tenant /v1/verdict).
+    """
+
+    enabled: bool = False
+    hbm_budget: int = 4
+    aot: bool = True
+    top_k_tenants: int = 8
+    quality: bool = True
+
+    def __post_init__(self):
+        for name in ("hbm_budget", "top_k_tenants"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"FleetConfig.{name}={v!r}: must be an int >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Logical device-mesh shape for pjit/GSPMD execution.
 
@@ -569,6 +599,7 @@ class Config:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     quality: QualityConfig = dataclasses.field(default_factory=QualityConfig)
     surface: SurfaceConfig = dataclasses.field(default_factory=SurfaceConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
@@ -602,6 +633,7 @@ class Config:
             obs=build(ObsConfig, d.get("obs", {})),
             quality=build(QualityConfig, d.get("quality", {})),
             surface=build(SurfaceConfig, d.get("surface", {})),
+            fleet=build(FleetConfig, d.get("fleet", {})),
         )
 
     @classmethod

@@ -89,6 +89,28 @@ def export_predictor(pred: Predictor, directory: str) -> str:
     return directory
 
 
+def export_aot_sidecar(pred: Predictor, checkpoint_dir: str,
+                       rungs=None) -> dict:
+    """Compile + serialize the fused serving executables NEXT TO THE
+    CHECKPOINT (``<ckpt>/aot/`` — serve/aot.py), the export-time half of
+    fleet admission-by-deserialize: a pool admitting this checkpoint
+    loads the artifacts instead of compiling the ladder.  Unlike the
+    StableHLO artifact above, AOT sidecars are params-AGNOSTIC (params
+    are runtime arguments) but platform-exact — the manifest fingerprint
+    gates the load.  Returns a summary of what was written."""
+    from deeprest_tpu.serve.aot import export_aot
+
+    manifest = export_aot(pred, checkpoint_dir, rungs=rungs)
+    entries = manifest["entries"]
+    return {
+        "dir": os.path.join(checkpoint_dir, "aot"),
+        "executables": len(entries),
+        "bytes": sum(e["bytes"] for e in entries),
+        "rungs": sorted({e["rung"] for e in entries}),
+        "platform": manifest["fingerprint"]["platform"],
+    }
+
+
 class ExportedPredictor(BatchedBackendMixin, FusedInferenceMixin):
     """Drop-in serving backend loaded from an artifact directory.
 

@@ -210,6 +210,16 @@ class FusedRolledEngine:
                              "static dense width the scatter targets)")
         self._jit_sparse = (jax.jit(self._program_sparse)
                             if self._sparse_nnz_cap is not None else None)
+        # AOT-deserialized executables (serve/aot.py): ``(kind, rung)``
+        # (kind in {"dense", "sparse"}) -> a loaded ``Compiled`` taking
+        # the SAME argument tree as the jitted program.  Dispatch prefers
+        # these, so an AOT-warmed plane serves its rungs without ever
+        # touching the jit cache — the executable ledger stays at zero
+        # for AOT-served rungs, which is what the fleet bench's
+        # zero-post-warmup-compiles gate asserts.  The dict OBJECT is
+        # shared across every engine adopted from this one
+        # (adopt_executables), so one load warms the whole fleet.
+        self._aot: dict[tuple[str, int], object] = {}
         self._lock = threading.Lock()
         self._pages = 0
         self._sparse_pages = 0
@@ -217,7 +227,66 @@ class FusedRolledEngine:
         self._padded_windows = 0
         self._series = 0
         self._max_dispatch_rows = 0
+        self._aot_pages = 0
         self._compiled: set[int] = set()
+
+    def adopt_executables(self, donor: "FusedRolledEngine") -> None:
+        """Serve through the donor's compiled-program set (fleet tier,
+        serve/fleet.py): params and normalization stats are runtime
+        ARGUMENTS of the fused program (see module docstring — baked
+        constants break bit parity), so engines of the same geometry can
+        serve different tenants' weights through ONE executable ladder
+        and ``jit_cache_size`` stays flat in the number of tenants.
+
+        Only trace-time constants must match: the donor's program baked
+        the window/delta/median/rung geometry and the params TREE
+        structure (quant mode decides leaf dtypes), so each is checked
+        loudly.  The dispatched-rung ledger and its lock are shared too
+        — ``cache_size()``/``stats()`` read plane-wide truth from any
+        adopted engine."""
+        import jax
+
+        if donor is self:
+            return
+        mine = dict(window_size=self.window_size, rungs=self.rungs,
+                    page=self.page, quant=self.quant,
+                    has_delta=self._has_delta, median=self._median,
+                    sparse_nnz_cap=self._sparse_nnz_cap,
+                    feature_dim=self._feature_dim)
+        theirs = dict(window_size=donor.window_size, rungs=donor.rungs,
+                      page=donor.page, quant=donor.quant,
+                      has_delta=donor._has_delta, median=donor._median,
+                      sparse_nnz_cap=donor._sparse_nnz_cap,
+                      feature_dim=donor._feature_dim)
+        if mine != theirs:
+            diff = {k: (mine[k], theirs[k]) for k in mine
+                    if mine[k] != theirs[k]}
+            raise ValueError(
+                "cannot share fused executables across mismatched engine "
+                f"geometry (mine vs donor): {diff}")
+        if not ((self._delta is None and donor._delta is None)
+                or (self._delta is not None and donor._delta is not None
+                    and np.array_equal(self._delta, donor._delta))):
+            raise ValueError(
+                "cannot share fused executables: delta masks differ "
+                "(the mask is a trace-time constant of the program)")
+        same_struct = (jax.tree_util.tree_structure(self._params)
+                       == jax.tree_util.tree_structure(donor._params))
+        if not same_struct:
+            raise ValueError(
+                "cannot share fused executables: params tree structures "
+                "differ (a different tree re-traces a new executable)")
+        # swap under our own (pre-adoption) lock so a concurrent dispatch
+        # on this engine never sees a half-adopted program set; the
+        # ``with`` holds the ORIGINAL lock object, so reassigning
+        # self._lock last is safe — after this block every path uses the
+        # donor's shared lock
+        with self._lock:
+            self._jit = donor._jit
+            self._jit_sparse = donor._jit_sparse
+            self._aot = donor._aot
+            self._compiled = donor._compiled
+            self._lock = donor._lock
 
     # -- device program -------------------------------------------------
 
@@ -292,7 +361,8 @@ class FusedRolledEngine:
 
     @property
     def sparse_enabled(self) -> bool:
-        return self._jit_sparse is not None
+        with self._lock:
+            return self._jit_sparse is not None
 
     def rung_for(self, n: int) -> int:
         for r in self.rungs:
@@ -335,7 +405,7 @@ class FusedRolledEngine:
         on the equivalent dense series (tests/test_sparse.py).  Pages
         ship as ``(cols, vals)`` — the ~F/(2K) feed-byte cut this entry
         exists for — and densify inside the fused executable."""
-        if self._jit_sparse is None:
+        if not self.sparse_enabled:
             raise ValueError(
                 "sparse feed is not enabled on this engine; construct it "
                 "with sparse_nnz_cap/feature_dim (InferConfig.sparse_feed)")
@@ -373,9 +443,29 @@ class FusedRolledEngine:
         # Coalesced dispatch stride: up to coalesce_pages pages per batch
         # (the super-rungs are in self.rungs, so rung_for always fits).
         page = self.page * self.coalesce_pages
+        # snapshot the program tables once — adopt_executables swaps them
+        # under the same lock, so the whole dispatch below runs against
+        # one coherent (jit, aot) generation
+        with self._lock:
+            aot_table = self._aot
+            jit_dense = self._jit
+            jit_sparse = self._jit_sparse
+            params = self._params
+        # A concurrent LRU spill (serve/fleet.py) swaps the tree for host
+        # numpy copies between resolve() and this dispatch; numpy leaves
+        # key a DIFFERENT executable signature than device arrays, so
+        # dispatching them would mint a second cache entry and trip the
+        # pool's frozen ledger.  Normalize to device arrays — the exact
+        # device_put a restore would have done, so values and the
+        # executable signature are both unchanged.
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+        if leaves and not isinstance(leaves[0], jax.Array):
+            params = jax.tree_util.tree_map(jax.device_put, params)
         carry = self._carry0
         dispatched = []
-        pages = padded = 0
+        pages = padded = aot_pages = 0
         lengths = [len(a[0]) if sparse else len(a) for a in arrays]
         for lo in range(0, len(metas), page):
             chunk = metas[lo:lo + page]
@@ -392,8 +482,13 @@ class FusedRolledEngine:
                     xv[row] = vals_i[s:s + w]
                     g[row] = gg
                     seg[row] = is_first
-                out, carry = self._jit_sparse(
-                    self._params, jnp.asarray(xc), jnp.asarray(xv),
+                # AOT-deserialized executable for this (kind, rung) when
+                # one is loaded (serve/aot.py); the lazily-jitted program
+                # otherwise — identical lowering, identical results.
+                fn = aot_table.get(("sparse", rung))
+                aot_pages += fn is not None
+                out, carry = (fn or jit_sparse)(
+                    params, jnp.asarray(xc), jnp.asarray(xv),
                     self._x_mn, self._x_rg, self._y_mn, self._y_rg,
                     carry, jnp.asarray(g), jnp.asarray(seg),
                     np.int32(len(chunk)), np.bool_(integrate))
@@ -404,8 +499,10 @@ class FusedRolledEngine:
                     x[row] = arrays[si][s:s + w]
                     g[row] = gg
                     seg[row] = is_first
-                out, carry = self._jit(
-                    self._params, jnp.asarray(x), self._x_mn, self._x_rg,
+                fn = aot_table.get(("dense", rung))
+                aot_pages += fn is not None
+                out, carry = (fn or jit_dense)(
+                    params, jnp.asarray(x), self._x_mn, self._x_rg,
                     self._y_mn, self._y_rg, carry, jnp.asarray(g),
                     jnp.asarray(seg), np.int32(len(chunk)),
                     np.bool_(integrate))
@@ -414,6 +511,7 @@ class FusedRolledEngine:
             padded += rung - len(chunk)
         with self._lock:
             self._pages += pages
+            self._aot_pages += aot_pages
             if sparse:
                 self._sparse_pages += pages
             self._windows += len(metas)
@@ -462,6 +560,11 @@ class FusedRolledEngine:
                 "series": self._series,
                 "max_dispatch_rows": self._max_dispatch_rows,
                 "dispatched_rungs": sorted(self._compiled),
+                # AOT serving surface (serve/aot.py): which rungs hold a
+                # deserialized executable, and how many pages they served
+                # (those pages never touched the jit cache)
+                "aot_rungs": sorted(r for _, r in self._aot),
+                "aot_pages": self._aot_pages,
                 "sparse_nnz_cap": self._sparse_nnz_cap,
                 "quant": self.quant,
             }
@@ -469,8 +572,10 @@ class FusedRolledEngine:
     def cache_size(self) -> int | None:
         """Compiled-executable count across the dense AND sparse fused
         programs (None when the running jax version has no cache probe)."""
+        with self._lock:
+            programs = (self._jit, self._jit_sparse)
         sizes = []
-        for fn in (self._jit, self._jit_sparse):
+        for fn in programs:
             probe = getattr(fn, "_cache_size", None) if fn is not None \
                 else None
             if callable(probe):

@@ -247,6 +247,57 @@ class Predictor(BatchedBackendMixin, FusedInferenceMixin):
                        if len(violations) > 8 else ""))
         return envelope
 
+    def share_executables_from(self, donor: "Predictor") -> None:
+        """Adopt the donor's jitted serving programs (fleet tier,
+        serve/fleet.py): params and normalization stats are runtime
+        ARGUMENTS throughout — ``_apply`` threads the params tree,
+        the fused engine threads params AND stats (serve/fused.py bit-
+        parity contract) — so predictors of the same architecture and
+        quant mode serve different tenants' weights through the SAME
+        compiled executables, and ``jit_cache_size`` stays flat in the
+        number of tenants.
+
+        The architecture/quant/geometry compatibility this requires is
+        checked loudly here and in ``FusedRolledEngine.
+        adopt_executables``; a mismatch would silently re-trace a new
+        executable per tenant, which is exactly the regression the fleet
+        bench's frozen-ledger gate exists to catch."""
+        if not isinstance(donor, Predictor):
+            raise TypeError(
+                f"can only share executables between Predictors, got "
+                f"{type(donor).__name__}")
+        if donor is self:
+            return
+        if self.model_config != donor.model_config:
+            raise ValueError(
+                "cannot share executables across architectures: "
+                f"{self.model_config} != {donor.model_config}")
+        if self.quant != donor.quant:
+            raise ValueError(
+                f"cannot share executables across quant modes "
+                f"({self.quant!r} vs {donor.quant!r}): the params tree "
+                "leaf dtypes differ, which re-traces per mode")
+        if self.window_size != donor.window_size:
+            raise ValueError(
+                f"cannot share executables across window sizes "
+                f"({self.window_size} vs {donor.window_size})")
+        if self.ladder.ladder != donor.ladder.ladder:
+            raise ValueError(
+                f"cannot share executables across shape ladders "
+                f"({self.ladder.ladder} vs {donor.ladder.ladder})")
+        if (self.sparse_feed, self.sparse_nnz_cap) != (
+                donor.sparse_feed, donor.sparse_nnz_cap):
+            raise ValueError(
+                "cannot share executables across sparse-feed settings")
+        self._apply = donor._apply
+        if self._apply_sparse is not None:
+            # the per-tenant entry wrapper closes over THIS predictor's
+            # stats/params and late-binds self._apply_sparse, so only
+            # the jitted function (and its cache) is shared
+            self._apply_sparse = donor._apply_sparse
+        if self._fused is not None and donor._fused is not None:
+            self._fused.adopt_executables(donor._fused)
+
     def params_digest(self) -> str:
         """Stable fingerprint of the served params — the ``params_hash``
         half of the capacity-surface cache key (serve/surface.py).

@@ -230,9 +230,15 @@ class EngineReplica:
         return int(self._m_served_windows.value(replica=self.name))
 
     def predict_series(self, traffic: np.ndarray,
-                       integrate: bool = True) -> np.ndarray:
-        with self._lock:
-            backend = self._backend
+                       integrate: bool = True, backend=None) -> np.ndarray:
+        # ``backend`` override: the fleet tier (serve/fleet.py) resolves
+        # tenant → pool-entry predictor BEFORE dispatch and serves this
+        # one request through it — the replica still owns the scheduling
+        # state (outstanding windows, drain flag), the pool owns the
+        # per-tenant weights.  None keeps the replica's own stack.
+        if backend is None:
+            with self._lock:
+                backend = self._backend
         n = self._begin(_num_windows(len(traffic), backend.window_size))
         try:
             with _device_ctx(self.device), \
@@ -244,9 +250,11 @@ class EngineReplica:
         finally:
             self._end(n)
 
-    def predict_series_many(self, series_list, integrate: bool = True):
-        with self._lock:
-            backend = self._backend
+    def predict_series_many(self, series_list, integrate: bool = True,
+                            backend=None):
+        if backend is None:
+            with self._lock:
+                backend = self._backend
         series_list = list(series_list)
         n = self._begin(sum(_num_windows(len(s), backend.window_size)
                             for s in series_list))
@@ -452,6 +460,18 @@ def _worker_main(spec: dict, conn) -> None:
         "y_stats": (backend.y_stats.to_dict()
                     if getattr(backend, "y_stats", None) is not None
                     else None),
+        # Per-tenant serving identity under a ``fleet`` key (ADDITIVE —
+        # every existing handshake field keeps its shape).  A worker
+        # subprocess serves exactly one stack, so its map has one entry,
+        # but the SHAPE matches the pool's /healthz view: consumers read
+        # fleet.tenants[t].{quant, params_digest} whether the plane is
+        # one process worker or a hundred-tenant pool.
+        "fleet": {"tenants": {"default": {
+            "quant": getattr(backend, "quant", "off"),
+            "params_digest": (backend.params_digest()
+                              if callable(getattr(backend, "params_digest",
+                                                  None)) else None),
+        }}},
     }))
     send_lock = threading.Lock()
 
@@ -601,6 +621,14 @@ class ProcessReplica:
         with self._lock:       # a reload swaps self._meta
             return self._meta["window_size"]
 
+    def fleet_meta(self) -> dict | None:
+        """The worker's per-tenant serving identity from the boot
+        handshake (``{"tenants": {name: {quant, params_digest}}}``) —
+        the process-replica half of the /healthz ``fleet`` view."""
+        with self._lock:
+            meta = self._meta
+        return meta.get("fleet") if meta is not None else None
+
     def outstanding(self) -> int:
         with self._lock:
             return self._outstanding
@@ -723,12 +751,27 @@ class ProcessReplica:
         return int(self._m_served_windows.value(replica=self.name))
 
     def predict_series(self, traffic: np.ndarray,
-                       integrate: bool = True) -> np.ndarray:
+                       integrate: bool = True, backend=None) -> np.ndarray:
+        if backend is not None:
+            # The override would need the tenant's params INSIDE the
+            # worker subprocess; shipping a params tree per request over
+            # the pipe is exactly the weight traffic the pool's
+            # device-resident LRU exists to avoid.
+            raise ValueError(
+                "fleet backend override is not supported on process "
+                "replicas — serve the fleet tier over in-process "
+                "(thread) replicas")
         traffic = np.ascontiguousarray(traffic, np.float32)
         n = _num_windows(len(traffic), self.window_size)
         return self._call("predict_series", (traffic, integrate), n)
 
-    def predict_series_many(self, series_list, integrate: bool = True):
+    def predict_series_many(self, series_list, integrate: bool = True,
+                            backend=None):
+        if backend is not None:
+            raise ValueError(
+                "fleet backend override is not supported on process "
+                "replicas — serve the fleet tier over in-process "
+                "(thread) replicas")
         series_list = [np.ascontiguousarray(s, np.float32)
                        for s in series_list]
         n = sum(_num_windows(len(s), self.window_size)

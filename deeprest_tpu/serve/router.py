@@ -375,6 +375,11 @@ class ReplicaRouter:
                   self._m_reloads_by_reason):
             obs_metrics.REGISTRY.expose(m)
         self._meta = self._probe_meta(replicas[0])
+        # Fleet tier (serve/fleet.py): attach_fleet installs a
+        # PredictorPool; tenant-aware dispatches then resolve tenant →
+        # pool entry FIRST and serve through the entry's predictor via
+        # the replica's backend override.
+        self._fleet = None
         # Render-time /metrics view over the replica plane: everything it
         # publishes is already counted by the replicas' and admission's
         # own obs counters — the collector adds zero steady-state cost.
@@ -533,6 +538,47 @@ class ReplicaRouter:
         """The PredictionService admission hook (fast 429 on overload)."""
         return self.admission.try_acquire(tenant)
 
+    # -- fleet tier (tenant → pool entry before dispatch) -----------------
+
+    def attach_fleet(self, pool) -> None:
+        """Install a :class:`~deeprest_tpu.serve.fleet.PredictorPool`:
+        every tenant-aware dispatch resolves through it and rides the
+        replicas' backend override.  The existing ``X-Tenant`` WRR front
+        keeps metering fairness — same header, two layers: admission
+        meters it, the pool resolves it."""
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            if not callable(getattr(r, "backend", None)):
+                raise ValueError(
+                    f"replica {r.name} ({r.kind}) cannot serve a fleet "
+                    "pool: the backend override needs in-process (thread) "
+                    "replicas — process workers would re-ship tenant "
+                    "params per request")
+        with self._lock:
+            self._fleet = pool
+
+    def fleet(self):
+        with self._lock:
+            return self._fleet
+
+    def _fleet_entry(self, tenant: str | None):
+        """Resolve tenant → pool entry for ONE request (LRU touch +
+        restore-if-spilled happen here, exactly once — retries reuse the
+        entry).  None when no pool is attached."""
+        with self._lock:
+            pool = self._fleet
+        if pool is None:
+            return None
+        from deeprest_tpu.serve.fleet import UnknownTenantError
+
+        try:
+            return pool.resolve(tenant)
+        except UnknownTenantError as exc:
+            raise ServingError(
+                f"unknown tenant {exc.args[0]!r}: not admitted to the "
+                "fleet pool", status=404) from None
+
     def _health_locked(self, replica) -> _ReplicaHealth:
         """The replica's health record (caller holds ``self._lock``)."""
         h = self._health.get(id(replica))
@@ -624,13 +670,30 @@ class ReplicaRouter:
             return out
 
     def predict_series(self, traffic: np.ndarray,
-                       integrate: bool = True) -> np.ndarray:
+                       integrate: bool = True,
+                       tenant: str | None = None) -> np.ndarray:
+        entry = self._fleet_entry(tenant)
+        if entry is not None:
+            backend = entry.predictor()
+            return self._dispatch(
+                lambda r: r.predict_series(traffic, integrate=integrate,
+                                           backend=backend),
+                {"series": 1, "tenant": entry.tenant})
         return self._dispatch(
             lambda r: r.predict_series(traffic, integrate=integrate),
             {"series": 1})
 
-    def predict_series_many(self, series_list, integrate: bool = True):
+    def predict_series_many(self, series_list, integrate: bool = True,
+                            tenant: str | None = None):
         series_list = list(series_list)
+        entry = self._fleet_entry(tenant)
+        if entry is not None:
+            backend = entry.predictor()
+            return self._dispatch(
+                lambda r: r.predict_series_many(series_list,
+                                                integrate=integrate,
+                                                backend=backend),
+                {"series": len(series_list), "tenant": entry.tenant})
         return self._dispatch(
             lambda r: r.predict_series_many(series_list,
                                             integrate=integrate),
@@ -1041,6 +1104,24 @@ class ReplicaRouter:
             "health": self.health_totals(),
             "autoscaler": decision,
         }
+
+    def params_digest(self) -> str | None:
+        """The lead replica's params digest (the /healthz fleet view's
+        single-tenant fallback; per-tenant digests live on the pool)."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            return None
+        backend = getattr(replicas[0], "backend", None)
+        if callable(backend):
+            probe = getattr(backend(), "params_digest", None)
+            return probe() if callable(probe) else None
+        fleet_meta = getattr(replicas[0], "fleet_meta", None)
+        if callable(fleet_meta):     # ProcessReplica boot handshake
+            meta = fleet_meta() or {}
+            default = meta.get("tenants", {}).get("default", {})
+            return default.get("params_digest")
+        return None
 
     def jit_cache_size(self) -> int | None:
         """Total executables across DISTINCT stacks (shared stacks count

@@ -244,6 +244,10 @@ class PredictionService:
         # collector JSONL); GET /v1/verdict renders its state.
         self.quality = None
         self._quality_ingestor = None
+        # Fleet tier (serve/fleet.py): attach_fleet installs a
+        # PredictorPool — X-Tenant then selects the MODEL (pool entry),
+        # not just the fairness bucket, on /v1/predict and /v1/verdict.
+        self.fleet = None
         self.whatif = (WhatIfEstimator(predictor, synthesizer)
                        if synthesizer is not None else None)
         # Capacity-surface plane: needs the what-if pipeline (a surface
@@ -294,6 +298,38 @@ class PredictionService:
             self.batching = config
         if old is not None:
             old.close()               # drain outside the lock
+
+    def attach_fleet(self, pool) -> None:
+        """Wire the fleet tier: ``pool`` (serve/fleet.PredictorPool)
+        resolves ``X-Tenant`` to a per-tenant predictor on /v1/predict,
+        serves per-tenant verdicts on /v1/verdict, and reports under the
+        /healthz ``fleet`` key.  A router backend learns the pool too,
+        so tenant resolution happens exactly once per request — on the
+        dispatch path, inside the router."""
+        with self._lock:
+            pred = self.predictor
+        attach = getattr(pred, "attach_fleet", None)
+        if callable(attach):
+            attach(pool)
+        with self._lock:
+            self.fleet = pool
+
+    @staticmethod
+    def _fleet_entry(pool, tenant: str | None, touch: bool):
+        """Tenant → pool entry, as HTTP: 404 for a tenant the pool never
+        admitted.  ``touch`` picks the dispatch-path resolve (LRU touch +
+        restore-if-spilled) vs the metadata peek — metadata reads must
+        not perturb the eviction order, and the router path resolves
+        inside the router, so the service only ever PEEKS there (one
+        touch per request, never two)."""
+        from deeprest_tpu.serve.fleet import UnknownTenantError
+
+        try:
+            return pool.resolve(tenant) if touch else pool.peek(tenant)
+        except UnknownTenantError as exc:
+            raise ServingError(
+                f"unknown tenant {exc.args[0]!r}: not admitted to the "
+                "fleet pool", status=404) from None
 
     def attach_quality(self, monitor, ingestor=None) -> None:
         """Wire the streaming verdict surface: ``monitor`` backs
@@ -464,6 +500,54 @@ class PredictionService:
                    help="spans currently in the recorder ring")
         sink.counter("deeprest_obs_spans_recorded_total", rec["recorded"],
                      help="spans committed since process start")
+        with self._lock:
+            pool = self.fleet
+        if pool is not None:
+            s = pool.stats()
+            sink.gauge("deeprest_fleet_tenants", s["tenants"],
+                       help="tenants admitted to the predictor pool")
+            sink.gauge("deeprest_fleet_resident_tenants", s["resident"],
+                       help="tenants with device-resident params (<= "
+                            "hbm_budget)")
+            sink.counter("deeprest_fleet_spills_total", s["spills"],
+                         help="tenant weight sets spilled to host memory")
+            sink.counter("deeprest_fleet_restores_total", s["restores"],
+                         help="tenant weight sets restored by device_put")
+            sink.counter("deeprest_fleet_aot_loaded_total",
+                         s["aot"]["loaded"],
+                         help="AOT executables deserialized at admission")
+            sink.counter("deeprest_fleet_compile_fallbacks_total",
+                         s["aot"]["compile_fallbacks"],
+                         help="admissions that had to compile (missing or "
+                              "stale AOT artifact)")
+            # Per-tenant quality gauges, DISTINCT names from the global
+            # deeprest_quality_* family (those carry a ``metric`` label;
+            # these roll metrics up per tenant) — cardinality bounded to
+            # the top-K tenants by serve count + one __other__ row.
+            for label, q in pool.quality_rollup():
+                labels = {"tenant": label}
+                sink.counter("deeprest_quality_tenant_sweeps_total",
+                             q["sweeps"],
+                             help="quality sweeps per tenant",
+                             labels=labels)
+                sink.gauge("deeprest_quality_tenant_verdict", q["verdict"],
+                           help="worst verdict state across the tenant's "
+                                "metrics (0 ok, 1 drift, 2 anomaly)",
+                           labels=labels)
+                sink.gauge("deeprest_quality_tenant_anomaly_score",
+                           q["anomaly_score"],
+                           help="worst anomaly score across the tenant's "
+                                "metrics", labels=labels)
+                if q["coverage"] is not None:
+                    sink.gauge("deeprest_quality_tenant_band_coverage",
+                               q["coverage"],
+                               help="mean q-band coverage across the "
+                                    "tenant's metrics", labels=labels)
+                if q["pinball"] is not None:
+                    sink.gauge("deeprest_quality_tenant_pinball_loss",
+                               q["pinball"],
+                               help="mean pinball loss across the "
+                                    "tenant's metrics", labels=labels)
 
     def metrics_text(self) -> str:
         """The Prometheus text exposition (``GET /metrics``)."""
@@ -541,6 +625,24 @@ class PredictionService:
             out["quant"]["parity_max"] = (max(measured.values())
                                           if measured else None)
             out["quant"]["parity_cells"] = len(measured)
+        # Fleet view (additive key): per-tenant {quant, params_digest,
+        # resident} instead of the single global pair above — existing
+        # key shapes untouched.  With a pool attached it is the pool's
+        # live map + counters; without one it is a one-tenant view over
+        # the SAME objects the global keys render (round-14 style), so
+        # consumers can read fleet.tenants[...] unconditionally.
+        with self._lock:
+            pool = self.fleet
+        if pool is not None:
+            out["fleet"] = {"tenants": pool.tenant_meta(
+                limit=pool.top_k_tenants), "pool": pool.stats()}
+        else:
+            digest = getattr(pred, "params_digest", None)
+            out["fleet"] = {"tenants": {"default": {
+                "quant": out["quant"]["mode"],
+                "params_digest": digest() if callable(digest) else None,
+                "resident": True,
+            }}, "pool": None}
         # span-recorder health (additive key): enabled flag, ring
         # retention, eviction pressure — the JSON twin of the /metrics
         # deeprest_obs_* gauges
@@ -563,11 +665,30 @@ class PredictionService:
             out["surface"] = surface.stats()
         return out
 
-    def verdict(self) -> dict:
+    def verdict(self, tenant: str | None = None) -> dict:
         """``GET /v1/verdict`` — the streaming per-(component,resource)
         ``ok|drift|anomaly`` surface (obs/quality.py), replacing the
         batch-only anomaly CLI path for live planes.  503 when no monitor
-        is attached (serve with --verdict-raw)."""
+        is attached (serve with --verdict-raw).
+
+        With a fleet pool attached, ``X-Tenant`` selects the tenant's OWN
+        monitor (one per pool entry) — the verdict surface is per-model
+        state, so it must never blend tenants."""
+        with self._lock:
+            pool = self.fleet
+        if pool is not None:
+            entry = self._fleet_entry(pool, tenant, touch=False)
+            monitor = entry.quality()
+            if monitor is None:
+                raise ServingError(
+                    f"tenant {entry.tenant!r} has no quality monitor: "
+                    "build the pool with quality enabled "
+                    "(FleetConfig.quality)", status=503)
+            out = monitor.verdicts()
+            out["tenant"] = {"name": entry.tenant,
+                             "params_digest": entry.key[1],
+                             "invalidations": entry.invalidations()}
+            return out
         with self._lock:
             quality = self.quality
         if quality is None:
@@ -617,12 +738,27 @@ class PredictionService:
                 f"{pred.window_size}")
         return traffic
 
-    def predict(self, payload: dict) -> dict:
+    def predict(self, payload: dict, tenant: str | None = None) -> dict:
         pred, _, _, _ = self._snapshot()
-        traffic = self._traffic_array(payload, pred)
-        preds = pred.predict_series(traffic)                  # [T, E, Q]
+        with self._lock:
+            pool = self.fleet
+        if pool is not None:
+            # Fleet tier: X-Tenant selects the MODEL.  Router backends
+            # resolve tenant → entry themselves (on the dispatch path,
+            # exactly once); the service peeks only for the response
+            # metadata.  Single-engine backends resolve here.
+            router = callable(getattr(pred, "attach_fleet", None))
+            entry = self._fleet_entry(pool, tenant, touch=not router)
+            model = entry.predictor()
+            traffic = self._traffic_array(payload, model)
+            preds = (pred.predict_series(traffic, tenant=tenant)
+                     if router else model.predict_series(traffic))
+            pred = model               # response metadata is per-tenant
+        else:
+            traffic = self._traffic_array(payload, pred)
+            preds = pred.predict_series(traffic)              # [T, E, Q]
         dm = getattr(pred, "delta_mask", None)
-        return {
+        out = {
             "metric_names": pred.metric_names,
             "quantiles": list(pred.quantiles),
             "predictions": preds.tolist(),
@@ -635,6 +771,13 @@ class PredictionService:
                 if dm is not None and bool(dm[e])
             ],
         }
+        if pool is not None:
+            # additive key: which pool entry answered (tenant +
+            # params_digest) — clients can pin responses to a weight
+            # generation across hot-swaps
+            out["tenant"] = {"name": entry.tenant,
+                             "params_digest": entry.key[1]}
+        return out
 
     def _require_whatif(self, whatif) -> WhatIfEstimator:
         if whatif is None:
@@ -959,7 +1102,14 @@ class PredictionServer:
                     return self._reply(404, {"error": f"no route {self.path}"})
                 try:
                     outer.service.maybe_reload()
-                    self._reply(200, getattr(outer.service, name)())
+                    if name == "verdict":
+                        # the verdict surface is per-tenant under a
+                        # fleet pool — same header as the WRR front
+                        body = outer.service.verdict(
+                            self.headers.get("X-Tenant"))
+                    else:
+                        body = getattr(outer.service, name)()
+                    self._reply(200, body)
                 except ServingError as e:   # e.g. /v1/verdict unattached
                     self._reply(e.status, {"error": str(e)},
                                 headers=e.headers)
@@ -1006,9 +1156,15 @@ class PredictionServer:
                                 if not isinstance(payload, dict):
                                     raise ServingError(
                                         "request body must be a JSON object")
-                                self._reply(
-                                    200,
-                                    getattr(outer.service, name)(payload))
+                                if name == "predict":
+                                    # tenant → model under a fleet pool
+                                    # (no pool: the kwarg is ignored)
+                                    body = outer.service.predict(
+                                        payload, tenant=tenant)
+                                else:
+                                    body = getattr(
+                                        outer.service, name)(payload)
+                                self._reply(200, body)
                 except ServingError as e:
                     self._reply(e.status, {"error": str(e)},
                                 headers=e.headers)

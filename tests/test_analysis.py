@@ -804,6 +804,45 @@ def measure():
 
 
 # ---------------------------------------------------------------------------
+# TN001: per-tenant mutable state outside a pool-entry accessor
+
+
+TN001_BAD = """
+class Router:
+    def dispatch(self, entry, traffic):
+        backend = entry._tenant_predictor       # bypasses the pool lock
+        entry._tenant_invalidations["manual"] = 1
+        return backend.predict_series(traffic)
+"""
+
+TN001_GOOD = """
+class Router:
+    def dispatch(self, entry, traffic):
+        backend = entry.predictor()             # accessor: pool-lock safe
+        entry.note_invalidation("manual")
+        return backend.predict_series(traffic)
+"""
+
+
+def test_tn001_pair():
+    assert_pair("TN001", TN001_BAD, TN001_GOOD, rel="serve/router.py")
+
+
+def test_tn001_owner_module_is_silent():
+    # serve/fleet.py OWNS the _tenant_* attributes — the accessors and the
+    # spill/restore bookkeeping live there, under the pool lock
+    assert not findings_for("TN001", TN001_BAD, rel="serve/fleet.py")
+
+
+def test_tn001_outside_serve_is_silent():
+    # the watchlist is the serving plane; a bench harness or test helper
+    # poking at entries is out of scope by construction
+    assert not findings_for("TN001", TN001_BAD, rel="benchmarks/bench.py")
+    assert not findings_for("TN001", TN001_BAD, rel="train/loop.py")
+    assert findings_for("TN001", TN001_BAD, rel="serve/server.py")
+
+
+# ---------------------------------------------------------------------------
 # DN001: dense traffic materialization in sparse-first hot modules
 
 
@@ -1078,7 +1117,8 @@ def test_rule_registry_complete():
             "TH001", "TH002", "TH003", "TH004",
             "HY001", "HY002", "OB001", "DN001", "DN002",
             "RS001", "RS002", "RS003", "RS004",
-            "EX001", "EX002", "EX003", "EX004"} <= set(rules)
+            "EX001", "EX002", "EX003", "EX004",
+            "TN001"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards
 
