@@ -76,6 +76,15 @@
 #                         fleet_bench.json; the on-chip cold-start and
 #                         restore numbers ride benchmarks/tpu_queue.sh
 #                         fleet_serve
+#   make wire-bench       the span-firehose ingestion gate (push wire vs
+#                         tailer-poll spans/sec at F=10240 sparse, >=10x
+#                         asserted; overload storm with the drop/
+#                         backpressure accounting identity; wire-vs-
+#                         tailer training bit-parity + zero post-warmup
+#                         compiles) — refreshes benchmarks/
+#                         wire_bench.json; host-CPU-bankable, the
+#                         tpu_queue.sh wire_ingest step re-banks it on
+#                         the pod host alongside the device steps
 
 PYTHON ?= python
 
@@ -128,6 +137,10 @@ quant-bench:
 fleet-bench:
 	$(PYTHON) benchmarks/fleet_bench.py --out benchmarks/fleet_bench.json
 
+wire-bench:
+	$(PYTHON) benchmarks/wire_bench.py --out benchmarks/wire_bench.json
+
 .PHONY: lint lint-changed lint-fix lint-sarif lint-gate native tsan \
 	bench-multichip serve-bench-replicas obs-bench tenk-bench \
-	chaos-bench drift-bench whatif-bench quant-bench fleet-bench
+	chaos-bench drift-bench whatif-bench quant-bench fleet-bench \
+	wire-bench

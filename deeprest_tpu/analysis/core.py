@@ -108,6 +108,18 @@ class SourceFile:
             self.tree = None
             self.syntax_error = e
         self._parents: dict[ast.AST, ast.AST] | None = None
+        self._all_nodes: tuple | None = None
+
+    def walk(self) -> tuple:
+        """Every node of the tree in ``ast.walk`` (BFS) order,
+        materialized once.  ~20 rule packs iterate the full tree of
+        every file; sharing one flattened pass keeps the package-wide
+        lint self-check inside its 10s tier-1 budget."""
+        nodes = getattr(self, "_all_nodes", None)   # absent on instances
+        if nodes is None:                           # revived by the cache
+            nodes = self._all_nodes = (tuple(ast.walk(self.tree))
+                                       if self.tree is not None else ())
+        return nodes
 
     def parents(self) -> dict[ast.AST, ast.AST]:
         """child → parent map (built lazily, once)."""
@@ -263,7 +275,7 @@ def all_rules() -> dict[str, Rule]:
 
     for pack in ("rules_jax", "rules_threading", "rules_hygiene",
                  "rules_obs", "rules_data", "rules_lifecycle",
-                 "rules_exceptions", "rules_fleet"):
+                 "rules_exceptions", "rules_fleet", "rules_wire"):
         importlib.import_module(f"deeprest_tpu.analysis.{pack}")
     return dict(_REGISTRY)
 
@@ -556,7 +568,7 @@ def in_loop(sf: SourceFile, node: ast.AST) -> bool:
 def iter_functions(sf: SourceFile) -> Iterator[ast.AST]:
     if sf.tree is None:
         return
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             yield node
@@ -724,7 +736,7 @@ class CallGraph:
         function-scoped lazy imports (the package's startup-cost idiom
         means most cross-module references live inside functions)."""
         table: dict[str, tuple] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     parts = tuple(a.name.split("."))

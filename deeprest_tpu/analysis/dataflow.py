@@ -450,7 +450,7 @@ class ValueFlow:
         for sf in self.project.files:
             if sf.tree is None:
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.Call):
                     continue
                 dotted = call_name(node.func)

@@ -69,8 +69,12 @@ class DN001DenseTrafficMaterialization(Rule):
     # whole mix grid.  Round 22 adds ops/quantize.py: quantization walks
     # every weight tensor at load time — a host-side F-trailing staging
     # buffer there would charge the whole feature width per reload.
+    # Round 24 adds data/wire.py: the firehose decodes straight into
+    # padded-COO rows — a dense [.,F] staging buffer in the receiver
+    # would re-dense every frame of a millions-of-spans/sec stream.
     WATCH = (("train", "stream.py"), ("data", "featurize.py"),
-             ("serve", "surface.py"), ("ops", "quantize.py"))
+             ("serve", "surface.py"), ("ops", "quantize.py"),
+             ("data", "wire.py"))
     WATCH_DIRS = ("obs",)
 
     def run(self, project: Project) -> Iterator[Finding]:

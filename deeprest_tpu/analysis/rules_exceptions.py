@@ -214,7 +214,7 @@ class EX003SwallowedException(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.ExceptHandler):
                     continue
                 if not self._broad(node.type):
@@ -310,7 +310,7 @@ class EX004DeviceLossSwallowedOutsideBarrier(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.Try):
                     continue
                 for handler in node.handlers:

@@ -53,7 +53,7 @@ class TN001TenantStateOutsideAccessor(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if (isinstance(node, ast.Attribute)
                         and node.attr.startswith(_TENANT_PREFIX)):
                     yield sf.finding(

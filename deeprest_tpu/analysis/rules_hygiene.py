@@ -28,7 +28,7 @@ def import_bindings(sf: SourceFile) -> list[tuple[str, ast.stmt, str]]:
     out: list[tuple[str, ast.stmt, str]] = []
     if sf.tree is None:
         return out
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 bound = a.asname or a.name.split(".")[0]
@@ -50,10 +50,10 @@ def used_names(sf: SourceFile) -> set[str]:
     used: set[str] = set()
     if sf.tree is None:
         return used
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Name):
             used.add(node.id)
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if (isinstance(node, ast.Assign)
                 and any(isinstance(t, ast.Name) and t.id == "__all__"
                         for t in node.targets)
@@ -93,7 +93,7 @@ def unreachable_tails(sf: SourceFile,
     out = []
     if sf.tree is None:
         return out
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         for field in ("body", "orelse", "finalbody"):
             block = getattr(node, field, None)
             if not isinstance(block, list):

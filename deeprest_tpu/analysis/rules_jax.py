@@ -93,7 +93,7 @@ def _jitted_functions(sf: SourceFile) -> list[tuple[ast.AST, ast.AST]]:
                         out.append((node, site))
                         return
 
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Call) and is_jit_call(node) and node.args:
             resolve(node.args[0], node)
         elif isinstance(node, ast.FunctionDef):
@@ -213,7 +213,7 @@ class JX002RecompileHazard(Rule):
         for sf in project.files:
             if sf.tree is None:
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not (isinstance(node, ast.Call) and is_jit_call(node)):
                     continue
                 if in_loop(sf, node):
@@ -282,7 +282,7 @@ class JX003ReadbackInHotLoop(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.Call):
                     continue
                 kind = self._readback_kind(node)
@@ -335,7 +335,7 @@ class JX005HandPinnedShardingSpec(Rule):
             parts = tuple(sf.rel.replace("\\", "/").split("/"))
             if parts[-2:] == self.ALLOWED_SUFFIX:
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.Call):
                     continue
                 if call_name(node.func) not in (
@@ -367,7 +367,7 @@ class JX004UseAfterDonation(Rule):
             donated = self._donated_callables(sf)
             if not donated:
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     yield from self._check_function(sf, node, donated)
 
@@ -392,7 +392,7 @@ class JX004UseAfterDonation(Rule):
         """``{dotted_callable_name: donated_positions}`` for every
         ``X = jax.jit(fn, donate_argnums=...)`` in the file."""
         out: dict[str, set[int]] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not (isinstance(node, ast.Assign)
                     and isinstance(node.value, ast.Call)
                     and is_jit_call(node.value)):

@@ -642,7 +642,7 @@ class RS003DelReliance(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.ClassDef):
                     continue
                 for m in node.body:

@@ -54,7 +54,7 @@ class OB001AdHocLatencyTimer(Rule):
         for sf in project.files:
             if sf.tree is None or not self._is_hot(sf.rel):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if (isinstance(node, ast.Call)
                         and call_name(node.func) in self._WALL):
                     yield sf.finding(

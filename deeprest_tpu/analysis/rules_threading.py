@@ -82,6 +82,23 @@ class Unit:
 
 
 class ClassModel:
+    @classmethod
+    def of(cls, sf: SourceFile, node: ast.ClassDef,
+           module_concurrent: bool,
+           graph: CallGraph | None = None) -> "ClassModel":
+        """Memoized constructor: TH001/TH002/TH004 each model the same
+        classes, and _build's per-method walks dominated the lint
+        self-check's 10s tier-1 budget.  The cache lives on the
+        SourceFile (one lint run's lifetime), keyed by everything
+        _build reads."""
+        cache = sf.__dict__.setdefault("_class_models", {})
+        key = (id(node), module_concurrent, graph is not None)
+        model = cache.get(key)
+        if model is None:
+            model = cache[key] = cls(sf, node, module_concurrent,
+                                     graph=graph)
+        return model
+
     def __init__(self, sf: SourceFile, node: ast.ClassDef,
                  module_concurrent: bool,
                  graph: CallGraph | None = None):
@@ -315,7 +332,7 @@ def _module_concurrent(sf: SourceFile) -> bool:
     any class the handlers reach is concurrently accessed."""
     if sf.tree is None:
         return False
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.Name, ast.Attribute)):
             if call_name(node) in _THREADED_SERVER_NAMES:
                 return True
@@ -346,7 +363,7 @@ class TH001AttributeRace(Rule):
             concurrent = _module_concurrent(sf)
             for node in sf.tree.body:
                 if isinstance(node, ast.ClassDef):
-                    model = ClassModel(sf, node, concurrent, graph=graph)
+                    model = ClassModel.of(sf, node, concurrent, graph=graph)
                     yield from model.races()
                     yield from self._shared_captures(sf, model)
 
@@ -403,7 +420,7 @@ class TH001AttributeRace(Rule):
             if ctor in _SYNC_FACTORIES:
                 synced.add(tgt)
             elif ctor in module_classes:
-                classes[tgt] = ClassModel(sf, module_classes[ctor], False)
+                classes[tgt] = ClassModel.of(sf, module_classes[ctor], False)
         return synced, classes
 
     @staticmethod
@@ -467,7 +484,7 @@ class TH003CrossProcessState(Rule):
 
     def _check(self, sf: SourceFile, cnode: ast.ClassDef,
                graph: CallGraph) -> Iterator[Finding]:
-        model = ClassModel(sf, cnode, False, graph=graph)
+        model = ClassModel.of(sf, cnode, False, graph=graph)
         methods = [n for n in cnode.body
                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         method_names = {m.name for m in methods}
@@ -540,7 +557,7 @@ class TH004LockDiscipline(Rule):
 
     def _check(self, sf: SourceFile,
                cnode: ast.ClassDef) -> Iterator[Finding]:
-        model = ClassModel(sf, cnode, False)
+        model = ClassModel.of(sf, cnode, False)
         if not model.lock_attrs:
             return
         method_names = {n.name for n in cnode.body

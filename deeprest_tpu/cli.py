@@ -540,9 +540,16 @@ def cmd_stream(args) -> int:
     )
 
     live = bool(args.jaeger_url or args.prom_url)
-    if live == bool(args.raw):
-        print("stream: need exactly one source — either --raw JSONL or "
-              "live --jaeger-url/--prom-url endpoints")
+    wire = bool(args.wire_listen)
+    if sum((bool(args.raw), live, wire)) != 1:
+        print("stream: need exactly one source — --raw JSONL, live "
+              "--jaeger-url/--prom-url endpoints, or a --wire-listen "
+              "push receiver")
+        return 2
+    if wire and not args.sparse_feed:
+        print("stream: --wire-listen requires the sparse feed "
+              "(the firehose is sparse-first by design; drop "
+              "--no-sparse-feed)")
         return 2
     if args.metric_map is not None and not live:
         # Silently ignoring it would hide a typo'd pipeline config.
@@ -597,6 +604,7 @@ def cmd_stream(args) -> int:
                                        capacity=args.capacity,
                                        hash_seed=args.hash_seed),
     )
+    receiver = None
     if live:
         from deeprest_tpu.data.ingest import LiveEndpointTailer, MetricRule
 
@@ -608,6 +616,21 @@ def cmd_stream(args) -> int:
         tailer = LiveEndpointTailer(
             jaeger_url=args.jaeger_url, prom_url=args.prom_url,
             bucket_s=args.bucket_seconds, resource_map=rmap)
+    elif wire:
+        from deeprest_tpu.data.wire import (
+            SpanFirehoseReceiver, parse_hostport,
+        )
+
+        host, port = parse_hostport(args.wire_listen)
+        # The receiver featurizes in its handler threads against the
+        # trainer's own CallPathSpace, so wire rows land in the ring
+        # bit-identical to the tailer path (tests/test_wire.py pins it).
+        receiver = SpanFirehoseReceiver(
+            host, port, space=st.space, sparse=True,
+            queue_depth=args.wire_queue_depth).start()
+        print(json.dumps({"wire_listen": "%s:%d" % receiver.address}),
+              flush=True)
+        tailer = receiver
     else:
         tailer = BucketTailer(args.raw)
     controller = None
@@ -615,29 +638,35 @@ def cmd_stream(args) -> int:
         from deeprest_tpu.train.stream import DriftController
 
         controller = DriftController(st, quality)
-    for r in st.run(tailer,
-                    max_refreshes=args.max_refreshes or None,
-                    deadline_s=args.deadline or None):
-        rec = {
-            "refresh": r.refresh, "buckets": r.num_buckets,
-            "train_loss": round(r.train_loss, 6),
-            "eval_loss": round(r.eval_loss, 6),
-            "checkpoint": r.checkpoint_path,
-            "trigger": r.trigger,
-            "etl": {"stall_s": round(r.etl_stall_s, 4),
-                    "lag_buckets": r.etl_lag_buckets,
-                    "dropped": r.etl_dropped},
-        }
-        if controller is not None and controller.monitor is not None:
-            v = controller.monitor.verdicts()
-            rec["quality"] = {"states": v.get("states"),
-                              "feature_drift":
-                                  v["feature_drift"].get("state"),
-                              "psi": v["feature_drift"].get("psi"),
-                              **{k: controller.stats[k]
-                                 for k in ("sweeps",
-                                           "retrains_triggered")}}
-        print(json.dumps(rec), flush=True)
+    try:
+        for r in st.run(tailer,
+                        max_refreshes=args.max_refreshes or None,
+                        deadline_s=args.deadline or None):
+            rec = {
+                "refresh": r.refresh, "buckets": r.num_buckets,
+                "train_loss": round(r.train_loss, 6),
+                "eval_loss": round(r.eval_loss, 6),
+                "checkpoint": r.checkpoint_path,
+                "trigger": r.trigger,
+                "etl": {"stall_s": round(r.etl_stall_s, 4),
+                        "lag_buckets": r.etl_lag_buckets,
+                        "dropped": r.etl_dropped},
+            }
+            if receiver is not None:
+                rec["wire"] = receiver.stats()
+            if controller is not None and controller.monitor is not None:
+                v = controller.monitor.verdicts()
+                rec["quality"] = {"states": v.get("states"),
+                                  "feature_drift":
+                                      v["feature_drift"].get("state"),
+                                  "psi": v["feature_drift"].get("psi"),
+                                  **{k: controller.stats[k]
+                                     for k in ("sweeps",
+                                               "retrains_triggered")}}
+            print(json.dumps(rec), flush=True)
+    finally:
+        if receiver is not None:
+            receiver.close()
     return 0
 
 
@@ -1001,7 +1030,11 @@ def cmd_serve(args) -> int:
                                 surface=surface_cfg)
     if fleet_pool is not None:
         service.attach_fleet(fleet_pool)
-    if args.verdict_raw:
+    verdict_wire = getattr(args, "verdict_wire_listen", None)
+    if args.verdict_raw and verdict_wire:
+        sys.exit("error: --verdict-raw and --verdict-wire-listen are "
+                 "alternative verdict-corpus sources; pick one")
+    if args.verdict_raw or verdict_wire:
         from deeprest_tpu.config import QualityConfig
         from deeprest_tpu.obs.quality import QualityMonitor
         from deeprest_tpu.serve.server import VerdictIngestor
@@ -1017,7 +1050,20 @@ def cmd_serve(args) -> int:
             QualityConfig(enabled=True,
                           sweep_every_buckets=args.verdict_sweep_every,
                           live_window=args.verdict_live_window))
-        ingestor = VerdictIngestor(service, BucketTailer(args.verdict_raw),
+        if verdict_wire:
+            from deeprest_tpu.data.wire import (
+                SpanFirehoseReceiver, parse_hostport,
+            )
+
+            # Bucket-mode receiver: the VerdictIngestor featurizes the
+            # buckets itself, so the wire stays a transport here (the
+            # featurized fast path belongs to the stream plane).
+            whost, wport = parse_hostport(verdict_wire)
+            vtailer = SpanFirehoseReceiver(whost, wport).start()
+            service.attach_wire(vtailer)
+        else:
+            vtailer = BucketTailer(args.verdict_raw)
+        ingestor = VerdictIngestor(service, vtailer,
                                    space, monitor).start()
         service.attach_quality(monitor, ingestor)
     server = PredictionServer(service, host=args.host, port=args.port)
@@ -1038,8 +1084,11 @@ def cmd_serve(args) -> int:
                                 if fleet_pool is not None else None),
                       "autoscale": autoscaler is not None,
                       "verdict": ({"raw": args.verdict_raw,
+                                   "wire": ("%s:%d" % vtailer.address
+                                            if verdict_wire else None),
                                    "sweep_every": args.verdict_sweep_every}
-                                  if args.verdict_raw else None),
+                                  if (args.verdict_raw or verdict_wire)
+                                  else None),
                       "obs": {"spans": not args.no_obs,
                               "span_capacity": args.obs_span_capacity,
                               "metrics": "/metrics"},
@@ -1511,6 +1560,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prom-url", default=None,
                    help="live Prometheus base URL (alternative source "
                         "to --raw)")
+    p.add_argument("--wire-listen", default=None, metavar="HOST:PORT",
+                   help="push-based span firehose: listen for framed "
+                        "span batches (data/wire.py protocol) and "
+                        "featurize them straight into the sparse ring "
+                        "— requires --sparse-feed")
+    p.add_argument("--wire-queue-depth", type=int, default=256,
+                   help="per-connection inflight frame budget before "
+                        "the receiver sends SLOWDOWN (2x = fast-drop "
+                        "with accounting, 4x drop streak = eviction)")
     p.add_argument("--bucket-seconds", type=float, default=5.0,
                    help="live-source discretization window (= scrape "
                         "interval)")
@@ -1775,6 +1833,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "continuous not-justified-by-traffic check) — "
                         "the streaming replacement for the batch anomaly "
                         "CLI")
+    p.add_argument("--verdict-wire-listen", default=None,
+                   metavar="HOST:PORT",
+                   help="arm the verdict surface from a push firehose "
+                        "instead of a tailed JSONL: listen for framed "
+                        "span batches (data/wire.py) and feed them to "
+                        "the VerdictIngestor — alternative to "
+                        "--verdict-raw")
     p.add_argument("--verdict-sweep-every", type=int, default=30,
                    metavar="N",
                    help="buckets between verdict-surface monitor sweeps")

@@ -582,6 +582,31 @@ class LiveEndpointTailer:
         self._cursor = (math.floor((self._now() - self.lag_s) / bucket_s)
                         * bucket_s)
 
+    # -- ingest-watermark convention (round 24, shared with the wire
+    # -- receiver in data/wire.py; train/stream.py persists it in the
+    # -- round-17 checkpoint sidecar and hands it back on resume) ------
+
+    def ingest_watermark(self) -> dict:
+        """This source's resume cursor: the bucket-aligned instant up to
+        which every poll result has been handed to the stream."""
+        return {"kind": "time_cursor", "position": float(self._cursor)}
+
+    def resume_from(self, wm: dict) -> None:
+        """Adopt a persisted cursor so a restarted stream re-polls the
+        gap since its last checkpoint exactly once — no bucket skipped,
+        none double-counted.  Foreign/malformed dialects are ignored
+        (the fresh now-lag cursor stands)."""
+        if not isinstance(wm, dict) or wm.get("kind") != "time_cursor":
+            return
+        try:
+            pos = float(wm["position"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if pos > 0:
+            # re-align defensively: a cursor off the bucket grid would
+            # shift every subsequent bucket boundary
+            self._cursor = math.floor(pos / self.bucket_s) * self.bucket_s
+
     def _note_failure(self, exc: Exception) -> None:
         import urllib.error
 

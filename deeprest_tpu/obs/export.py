@@ -125,5 +125,32 @@ def self_corpus(spans: Sequence[SpanRecord], bucket_s: float):
                      bucket_s)
 
 
+def push_self_corpus(address, bucket_s: float = 5.0,
+                     spans: Sequence[SpanRecord] | None = None,
+                     client_id: str = "deeprest-obs") -> int:
+    """Self-ingestion over the wire: drain the plane's own span recorder
+    into Buckets (via :func:`self_corpus` — the SAME adapters as the
+    file path) and push them to a listening SpanFirehoseReceiver
+    (data/wire.py).  This makes the serving plane its own first live
+    wire client: ``serve`` records spans, ``stream --wire-listen``
+    retrains on them, no files in between.
+
+    Returns the number of buckets pushed (0 when the recorder is empty
+    — an idle plane pushes nothing rather than an empty frame)."""
+    from deeprest_tpu.data.wire import push_corpus
+    from deeprest_tpu.obs.spans import RECORDER
+
+    if spans is None:
+        spans = RECORDER.drain()
+    if not spans:
+        return 0
+    buckets = self_corpus(spans, bucket_s)
+    if not buckets:
+        return 0
+    push_corpus(address, buckets, client_id=client_id)
+    return len(buckets)
+
+
 __all__ = ["spans_to_jaeger", "spans_to_prometheus", "write_jaeger_json",
-           "write_prometheus_json", "self_corpus", "BUSY_METRIC"]
+           "write_prometheus_json", "self_corpus", "push_self_corpus",
+           "BUSY_METRIC"]

@@ -244,6 +244,11 @@ class PredictionService:
         # collector JSONL); GET /v1/verdict renders its state.
         self.quality = None
         self._quality_ingestor = None
+        # Wire firehose (data/wire.py): attach_wire registers a started
+        # SpanFirehoseReceiver so /healthz renders its drop/backpressure
+        # accounting.  Lifecycle stays with whoever polls it (the
+        # VerdictIngestor's stop() closes its tailer).
+        self._wire = None
         # Fleet tier (serve/fleet.py): attach_fleet installs a
         # PredictorPool — X-Tenant then selects the MODEL (pool entry),
         # not just the fairness bucket, on /v1/predict and /v1/verdict.
@@ -341,6 +346,14 @@ class PredictionService:
             old, self._quality_ingestor = self._quality_ingestor, ingestor
         if old is not None:
             old.stop()
+
+    def attach_wire(self, receiver) -> None:
+        """Register a started SpanFirehoseReceiver (data/wire.py) for
+        observability: /healthz gains an additive ``wire`` key with its
+        span/drop/backpressure accounting.  The receiver's lifecycle is
+        NOT owned here — its poller (the VerdictIngestor) closes it."""
+        with self._lock:
+            self._wire = receiver
 
     def close(self) -> None:
         """Release the batcher's worker thread (idempotent).  Tolerates
@@ -625,6 +638,14 @@ class PredictionService:
             out["quant"]["parity_max"] = (max(measured.values())
                                           if measured else None)
             out["quant"]["parity_cells"] = len(measured)
+        # Wire firehose accounting (additive key): span/batch/drop/
+        # backpressure totals of an attached push receiver — the same
+        # counter shapes the obs registry exports at /metrics, so the
+        # two views stay consistent (tests/test_wire.py pins it).
+        with self._lock:
+            wire = self._wire
+        if wire is not None:
+            out["wire"] = wire.stats()
         # Fleet view (additive key): per-tenant {quant, params_digest,
         # resident} instead of the single global pair above — existing
         # key shapes untouched.  With a pool attached it is the pool's

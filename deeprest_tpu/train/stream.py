@@ -413,6 +413,14 @@ class StreamingTrainer:
         # restarted stream knows how far the corpus had advanced — the
         # retained-ring half of the preemption cursor (ROADMAP item 7).
         self._ingested_total = 0
+        # The active stream source (set by run()) and the source-side
+        # half of the watermark convention it shares with the sidecar:
+        # sources exposing ingest_watermark()/resume_from() — the wire
+        # receiver (data/wire.py) and LiveEndpointTailer — persist their
+        # cursor next to _ingested_total and get it back on resume, so a
+        # restarted stream never double-counts replayed spans.
+        self._source = None
+        self._resume_source_watermark: dict | None = None
         # Set on resume: the delta mask the restored params were TRAINED
         # with.  refresh() must keep using it — y_stats and params both
         # encode the target space, so silently switching a resumed stream
@@ -764,12 +772,23 @@ class StreamingTrainer:
 
     def _ring_watermark(self) -> dict:
         """The retained-ring half of the preemption cursor: how far the
-        corpus had advanced when this checkpoint was cut."""
-        return {
+        corpus had advanced when this checkpoint was cut.  When the
+        active source speaks the watermark convention (wire receiver,
+        live tailer), its own cursor rides along under ``source`` so
+        resume can hand it back via ``resume_from`` — the stream and its
+        source re-anchor on the SAME instant and replays dedup instead
+        of double-counting."""
+        out = {
             "ingested_total": int(self._ingested_total),
             "retained_buckets": int(self.num_buckets),
             "pending_buckets": int(self._pending),
         }
+        wm_fn = getattr(self._source, "ingest_watermark", None)
+        if callable(wm_fn):
+            sw = wm_fn()
+            if isinstance(sw, dict):
+                out["source"] = sw
+        return out
 
     def _snapshot_extra(self) -> dict:
         out = {
@@ -921,6 +940,10 @@ class StreamingTrainer:
                 self._ingested_total = int(wm.get("ingested_total", 0))
             except (TypeError, ValueError):
                 pass
+            sw = wm.get("source")
+            if isinstance(sw, dict):
+                # handed to the source in run() via resume_from()
+                self._resume_source_watermark = sw
         print(f"stream: resumed from {self.ckpt_dir} "
               f"(refresh {self._refresh_count}, "
               f"{len(self.metric_names)} metrics frozen)")
@@ -950,7 +973,18 @@ class StreamingTrainer:
         the queue and readiness is checked once per batch, exactly as the
         serial loop does — same buckets in, same refresh results out
         (tests/test_stream.py pins this determinism).
+
+        A FEATURIZED source (``tailer.featurized`` — the wire receiver,
+        which featurizes on its own connection threads) yields
+        ready-made ``(row, metrics_row)`` tuples; both loops commit
+        those via ``_ingest_featurized`` instead of re-featurizing.  A
+        source speaking the watermark convention gets the sidecar's
+        persisted cursor handed back here before the first poll.
         """
+        self._source = tailer
+        rf = getattr(tailer, "resume_from", None)
+        if callable(rf) and self._resume_source_watermark is not None:
+            rf(self._resume_source_watermark)
         if getattr(self.config, "etl", None) is not None \
                 and self.config.etl.overlap:
             yield from self._run_overlapped(tailer, max_refreshes,
@@ -998,8 +1032,12 @@ class StreamingTrainer:
                 # Stopwatch (obs/metrics.py): the sanctioned elapsed-time
                 # clock OB001 migrates hot serve/train modules onto.
                 sw = obs_metrics.Stopwatch()
-                for bucket in got:
-                    self.ingest(bucket)
+                if getattr(tailer, "featurized", False):
+                    for feat in got:
+                        self._ingest_featurized(feat)
+                else:
+                    for bucket in got:
+                        self.ingest(bucket)
                 stall += sw.elapsed()
             if self.ready():
                 yield self._finish_refresh(
@@ -1031,8 +1069,12 @@ class StreamingTrainer:
                     if got:
                         # One queue item per poll batch, kept atomic so the
                         # train thread's readiness checks land on the same
-                        # batch boundaries as the serial loop's.
-                        buf.put([self._featurize(b) for b in got], stop)
+                        # batch boundaries as the serial loop's.  A
+                        # featurized source's rows pass straight through
+                        # (its own threads already did the ETL work).
+                        buf.put(got if getattr(tailer, "featurized", False)
+                                else [self._featurize(b) for b in got],
+                                stop)
                     elif not getattr(tailer, "backlog", False):
                         stop.wait(self.stream.poll_interval_s)
             except BaseException as exc:  # deterministic tailer failures etc.

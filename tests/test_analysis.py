@@ -843,6 +843,78 @@ def test_tn001_outside_serve_is_silent():
 
 
 # ---------------------------------------------------------------------------
+# WR001: per-frame allocation / blocking call in a wire recv hot loop
+
+
+WR001_BAD = """
+import json
+
+class Tap:
+    def serve(self, sock):
+        buf = b""
+        while self.alive:
+            buf += sock.recv(4096)
+            msg = json.loads(buf)               # O(connection) per frame
+            print("frame", msg["seq"])          # blocking shared stream
+            open("/tmp/tap.log", "a").write("x")  # file I/O mid-frame
+            self.frames.append(msg)             # no len() bound anywhere
+"""
+
+WR001_GOOD = """
+import json
+
+class Tap:
+    def serve(self, sock):
+        while self.alive:
+            n = self._recv_exact(sock, self.hdr)   # framed: no re-parse
+            if not n:
+                break
+            self._on_frame(bytes(self.hdr))        # work outside the loop
+
+    def _on_frame(self, payload):
+        msg = json.loads(payload)                  # once per frame, helper
+        if len(self.frames) >= self.max_buffered:  # explicit bound
+            self.dropped += 1
+            return
+        self.frames.append(msg)
+"""
+
+
+def test_wr001_pair():
+    assert_pair("WR001", WR001_BAD, WR001_GOOD,
+                rel="deeprest_tpu/data/wire_tap.py")
+
+
+def test_wr001_scoped_to_wire_modules():
+    # the recv-loop discipline is a wire-transport contract; the same
+    # shape in an ingest poller or a test helper is out of scope
+    assert not findings_for("WR001", WR001_BAD, rel="data/ingest.py")
+    assert not findings_for("WR001", WR001_BAD, rel="tests/helpers.py")
+    assert findings_for("WR001", WR001_BAD, rel="serve/wire_fanin.py")
+
+
+def test_wr001_each_shape_reported():
+    # all four banned shapes in the bad fixture produce findings
+    fired = findings_for("WR001", WR001_BAD, rel="data/wire_tap.py")
+    msgs = " ".join(f.message for f in fired)
+    assert "open()" in msgs
+    assert "print()" in msgs
+    assert "json.loads(buf)" in msgs
+    assert "self.frames.append()" in msgs
+
+
+def test_wr001_real_receiver_is_silent():
+    # the shipped receiver keeps its recv loop frame-accounting-only:
+    # the rule must hold on the real module, not just fixtures
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "deeprest_tpu", "data", "wire.py")
+    src = open(path, encoding="utf-8").read()
+    assert not findings_for("WR001", src, rel="deeprest_tpu/data/wire.py")
+
+
+# ---------------------------------------------------------------------------
 # DN001: dense traffic materialization in sparse-first hot modules
 
 
@@ -1118,7 +1190,7 @@ def test_rule_registry_complete():
             "HY001", "HY002", "OB001", "DN001", "DN002",
             "RS001", "RS002", "RS003", "RS004",
             "EX001", "EX002", "EX003", "EX004",
-            "TN001"} <= set(rules)
+            "TN001", "WR001"} <= set(rules)
     for rule in rules.values():
         assert rule.title and rule.guards
 

@@ -1,0 +1,77 @@
+"""Tier-1 smoke for the wire-ingestion benchmark harness:
+`wire_bench.py --quick` must run end to end on every suite pass so the
+push receiver, the framing, the storm accounting, and the bench's own
+plumbing cannot rot between full bench runs.  CPU/numpy-only — the
+quick tier never initializes a JAX backend (the bench.py parent-process
+contract etl_bench's quick mode established)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "wire_bench.py")
+
+
+def test_quick_mode_emits_sound_json(tmp_path):
+    out = tmp_path / "wire_bench.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    # stdout's last line and the --out file carry the same record
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert json.load(open(out)) == result
+    assert result["schema_version"] == 1
+    assert result["metric"] == "wire_ingest"
+    assert result["quick"] is True
+
+    tp = result["throughput"]
+    assert tp["capacity"] == 512
+    assert tp["buckets"] > 0 and tp["spans"] > 0
+    assert tp["tailer_spans_per_sec"] > 0
+    assert tp["wire_spans_per_sec"] > 0
+    assert tp["dropped"] == 0
+    assert tp["p99_ingest_ms"] is None or tp["p99_ingest_ms"] >= 0
+    # A warm pass re-sends byte-identical trace blobs, so the memo must
+    # be doing nearly all the work; a broken memo shows up here long
+    # before the full bench's >=10x F=10240 gate runs.
+    assert tp["memo_hit_rate"] > 0.5
+    # The full bench bar is >=10x at F=10240 (committed wire_bench.json:
+    # measured ~26x); >1 here keeps the smoke robust to a noisy shared-CI
+    # host while still catching a silent fall-through to a re-parse path.
+    assert tp["speedup_vs_tailer"] > 1.0
+
+    storm = result["storm"]
+    assert storm["dropped"] > 0
+    assert storm["backpressure_frames"] > 0
+    # The accounting identity the bench asserts internally, re-stated on
+    # the emitted record: nothing the client sent vanished silently.
+    assert (storm["accepted"] + storm["dropped"] + storm["duplicates"]
+            == storm["frames_sent"])
+    assert storm["drained"] == storm["accepted"]
+
+
+def test_committed_artifact_is_current():
+    """The committed full-run artifact must exist, carry the >=10x
+    F=10240 headline bench.py's v15 keys read, and agree with its own
+    internal gates — a stale or hand-edited artifact fails here."""
+    with open(os.path.join(REPO, "benchmarks", "wire_bench.json"),
+              encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rec["quick"] is False
+    tp = rec["throughput"]
+    assert tp["capacity"] == 10240
+    assert tp["speedup_vs_tailer"] >= 10.0
+    assert tp["wire_spans_per_sec"] > tp["tailer_spans_per_sec"]
+    assert tp["dropped"] == 0
+    assert tp["p99_ingest_ms"] is not None and tp["p99_ingest_ms"] >= 0
+    parity = rec["refresh_parity"]
+    assert parity["params_bit_identical"] is True
+    assert parity["post_warmup_compiles"] == 0
+    storm = rec["storm"]
+    assert (storm["accepted"] + storm["dropped"] + storm["duplicates"]
+            == storm["frames_sent"])

@@ -1,0 +1,241 @@
+"""Wire-tier chaos: the firehose under kills, storms, and slow
+consumers (round 24 satellite of tests/test_chaos.py).
+
+The contract under fire is the same one the protocol docstring states
+in the calm: an ACK means the spans reached the ring, a crash loses
+only unACKed frames and the client replays exactly those, overload
+drops are counted and announced (never silent), a consumer that ignores
+the announcements is evicted, and after any of it the process census —
+threads and fds — returns to its pre-storm baseline."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from deeprest_tpu.config import FeaturizeConfig
+from deeprest_tpu.data.featurize import CallPathSpace
+from deeprest_tpu.data.wire import (
+    SpanFirehoseReceiver, WireClient, encode_bucket_payload,
+)
+from deeprest_tpu.workload import normal_scenario, simulate_corpus
+
+
+def _corpus(buckets: int, seed: int = 0):
+    scn = normal_scenario(seed)
+    scn.calls_per_user = 0.4
+    return simulate_corpus(scn, buckets)
+
+
+def _space(capacity: int = 256) -> CallPathSpace:
+    return CallPathSpace(config=FeaturizeConfig(
+        hash_features=True, capacity=capacity)).freeze()
+
+
+def _metrics_rows(buckets) -> list:
+    return [{m.key: m.value for m in b.metrics} for b in buckets]
+
+
+def _drain_frames(rx, n_frames: int, deadline_s: float = 30.0) -> list:
+    out, frames = [], 0
+    deadline = time.monotonic() + deadline_s
+    while frames < n_frames:
+        got = rx.poll()
+        frames += len(got)
+        out.extend(got)
+        if not got:
+            assert time.monotonic() < deadline, \
+                f"drained {frames}/{n_frames} before deadline"
+            time.sleep(0.002)
+    return out
+
+
+def _census():
+    return (threading.active_count(), len(os.listdir("/proc/self/fd")))
+
+
+def _await_census(baseline, deadline_s: float = 15.0) -> None:
+    base_threads, base_fds = baseline
+    deadline = time.monotonic() + deadline_s
+    while True:
+        threads, fds = _census()
+        if threads <= base_threads and fds <= base_fds:
+            return
+        assert time.monotonic() < deadline, (
+            f"post-storm census {(threads, fds)} never returned to the "
+            f"baseline {baseline}: leaked threads or fds")
+        time.sleep(0.05)
+
+
+def _drain_frames_exactly(rx, n: int, deadline_s: float = 30.0) -> list:
+    out = []
+    deadline = time.monotonic() + deadline_s
+    while len(out) < n:
+        out.extend(rx.poll(max_items=n - len(out)))
+        if len(out) < n:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+    return out
+
+
+def test_receiver_kill_midstream_then_clean_reconnect():
+    """Kill the receiver with half the stream unACKed; a fresh receiver
+    on the same port, handed the persisted watermark, gets exactly the
+    lost half replayed — every bucket arrives once, in order, none
+    half-applied, none double-counted."""
+    baseline = _census()
+    corpus = _corpus(20)
+    expected = _metrics_rows(corpus)
+
+    rx1 = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    port = rx1.address[1]
+    client = WireClient(rx1.address, client_id="chaos-kill",
+                        pending_limit=200).connect()
+    for b in corpus[:10]:
+        client.send_bucket(b)
+    # decode catches up, then the train thread drains (= commits) 5
+    deadline = time.monotonic() + 30
+    while rx1.stats()["batches"] < 10:
+        assert time.monotonic() < deadline, rx1.stats()
+        time.sleep(0.002)
+    items = _drain_frames_exactly(rx1, 5)
+    wm = rx1.ingest_watermark()
+    assert wm == {"kind": "wire_seq", "clients": {"chaos-kill": 5}}
+    rx1.close()          # KILL: frames 6..10 were decoded but never
+    #                      committed — with the receiver they die
+
+    rx2 = SpanFirehoseReceiver("127.0.0.1", port, space=_space()).start()
+    rx2.resume_from(wm)
+    # The client keeps streaming; its first contact with the dead socket
+    # triggers reconnect + replay of everything past the watermark.  A
+    # drainer stands in for the train thread — the client's flush blocks
+    # on ACKs, and ACKs are a drain-side promise.
+    late: list = []
+    drainer = threading.Thread(
+        target=lambda: late.extend(
+            _drain_frames(rx2, 20 - len(items), deadline_s=40)),
+        daemon=True)
+    drainer.start()
+    for b in corpus[10:]:
+        client.send_bucket(b)
+    assert client.flush(timeout_s=30)
+    drainer.join(timeout=40)
+    assert not drainer.is_alive(), "drainer wedged short of 20 buckets"
+    items += late
+    assert client.reconnects >= 1
+    client.close()
+    stats = rx2.stats()
+    rx2.close()
+
+    got = [metrics_row for (_row, metrics_row) in items]
+    assert got == expected, \
+        "kill+reconnect lost or double-applied a bucket"
+    assert stats["dropped"] == 0
+    _await_census(baseline)
+
+
+def test_backpressure_storm_accounts_for_every_frame():
+    """Fire at a tiny admission window with nobody draining: SLOWDOWN
+    reaches the producer, the drop band engages, and when the dust
+    settles every sent frame is accepted, dropped-with-notice, or a
+    deduped replay — then the backlog drains clean."""
+    baseline = _census()
+    corpus = _corpus(6, seed=7)
+    payloads = [encode_bucket_payload(corpus[i % len(corpus)])
+                for i in range(64)]
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space(),
+                              queue_depth=4, evict_after=10_000).start()
+    client = WireClient(rx.address, client_id="chaos-storm",
+                        pending_limit=1000,
+                        slowdown_pause_s=0.001).connect()
+    try:
+        for pl in payloads:
+            client._send_batch(pl, flags=0)
+        deadline = time.monotonic() + 30
+        stats = rx.stats()
+        while (stats["batches"] + stats["dropped"] + stats["duplicates"]
+               < len(payloads)):
+            assert time.monotonic() < deadline, stats
+            time.sleep(0.005)
+            stats = rx.stats()
+        assert stats["backpressure"] > 0
+        assert stats["dropped"] > 0
+        # The notices may still sit unread in the client's socket buffer
+        # — the client only learns about shed frames when it drains (the
+        # next send or flush, in real use).  Drain explicitly before
+        # asserting the client-side view, or a loaded host races the
+        # server's notice writes against the client's last send.
+        deadline = time.monotonic() + 10
+        while client.slowdowns == 0 or client.server_dropped == 0:
+            assert time.monotonic() < deadline, (
+                client.slowdowns, client.server_dropped)
+            client._drain_server(block=True)
+        assert client.slowdowns > 0
+        assert client.server_dropped > 0
+        assert (stats["batches"] + stats["dropped"] + stats["duplicates"]
+                == client.sent_batches)
+        drained = _drain_frames(rx, stats["batches"])
+        assert len(drained) == stats["batches"]
+        assert not rx.backlog
+    finally:
+        client.close()
+        rx.close()
+    _await_census(baseline)
+
+
+def test_slow_consumer_is_evicted_and_counted():
+    """A producer that blows through the drop band for evict_after
+    consecutive frames loses its connection — visibly (evictions
+    counter), and the frames admitted before the ban still drain."""
+    baseline = _census()
+    (bucket,) = _corpus(1, seed=11)
+    payload = encode_bucket_payload(bucket)
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space(),
+                              queue_depth=1, evict_after=4).start()
+    client = WireClient(rx.address, client_id="chaos-evict",
+                        pending_limit=1000, reconnect=False,
+                        slowdown_pause_s=0.0).connect()
+    try:
+        sent = 0
+        try:
+            for _ in range(64):
+                client._send_batch(payload, flags=0)
+                sent += 1
+        except (OSError, ConnectionError):
+            pass                    # the eviction landed mid-send
+        deadline = time.monotonic() + 30
+        while rx.stats()["evictions"] < 1:
+            assert time.monotonic() < deadline, rx.stats()
+            time.sleep(0.005)
+        stats = rx.stats()
+        assert stats["evictions"] == 1
+        assert stats["dropped"] >= 4        # the streak that earned it
+        # the connection is really gone, not just counted
+        deadline = time.monotonic() + 10
+        while rx.connections > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        drained = _drain_frames(rx, stats["batches"])
+        assert len(drained) == stats["batches"] >= 1
+    finally:
+        client.close()
+        rx.close()
+    _await_census(baseline)
+
+
+def test_close_is_idempotent_and_releases_everything():
+    baseline = _census()
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    client = WireClient(rx.address, client_id="chaos-close").connect()
+    client.send_bucket(_corpus(1)[0])
+    _drain_frames(rx, 1)      # commit, so close()'s flush gets its ACK
+    client.close()
+    rx.close()
+    rx.close()                               # second close is a no-op
+    _await_census(baseline)
+    # a closed receiver still answers stats()/watermark reads (the
+    # shutdown printout in cli stream reads them after the run loop)
+    assert isinstance(rx.stats(), dict)
+    assert json.dumps(rx.ingest_watermark())
