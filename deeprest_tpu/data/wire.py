@@ -23,12 +23,15 @@ Frame types::
     BATCH     c->s  one bucket (sub-framed payload, below); seq is the
                     client's monotone batch sequence
     ACK       s->c  seq = highest committed sequence (advances when the
-                    train thread drains the bucket, not at receipt)
+                    drained rows LAND IN THE RING — commit — not at
+                    receipt, and not at drain: see "Commit" below)
     SLOWDOWN  s->c  JSON {"inflight": n, "limit": n} — explicit
                     backpressure; compliant clients pause
-    DROPPED   s->c  JSON {"through": seq, "count": n} — frames were
-                    fast-dropped under overload; the client prunes them
-                    (load shed with accounting, never a silent stall)
+    DROPPED   s->c  JSON {"seqs": [..], "count": n} — exactly the
+                    sequence numbers fast-dropped under overload (or
+                    malformed); the client prunes those and ONLY those
+                    (load shed with accounting, never a silent stall —
+                    accepted-but-unACKed frames stay replayable)
     BYE       either direction, clean close
 
 BATCH payload (Jaeger-shape JSON inside binary sub-framing)::
@@ -63,6 +66,23 @@ A producer that stays in the drop band for ``evict_after`` consecutive
 frames is a slow consumer of our control frames and is evicted
 (connection closed, counted) so it cannot monopolize the buffer other
 connections share.
+
+Commit: ACK means "in the ring", on every consumer shape
+--------------------------------------------------------
+The per-client watermark (what WELCOME reports, what ACK advances,
+what the sidecar persists) must never run ahead of the ring, or a
+kill+resume loses the gap: the client pruned on ACK, and the resumed
+watermark says the frames are already ingested.  ``poll()`` drains AND
+commits in one call — correct whenever the caller ingests the items on
+the same thread before anything can observe the watermark (the serial
+train loop, the VerdictIngestor).  A consumer that hands drained items
+to ANOTHER thread (the overlapped ETL loop, where rows wait in a
+bounded queue before ``_ingest_featurized``) must instead use
+``poll_deferred()`` → ``(items, token)`` and call ``commit(token)``
+only after the rows land — the stream's overlapped loop threads the
+token through its ETL buffer and commits post-ingest, so a checkpoint
+cut between drain and ingest can never persist a watermark covering
+frames that are not in the ring.
 
 Watermark convention (shared with data/ingest.LiveEndpointTailer)
 -----------------------------------------------------------------
@@ -186,12 +206,15 @@ def _recv_exact(sock: socket.socket, view: memoryview, *,
 
 class _Conn:
     """Per-connection accounting.  ``enqueued`` is written only by the
-    handler thread and ``drained`` only by the poll (train) thread — two
-    single-writer monotone counters, so ``inflight`` needs no lock and
-    a stale read only ever delays backpressure by one frame."""
+    handler thread and ``drained`` only by the committing (train) thread
+    — two single-writer monotone counters, so ``inflight`` needs no lock
+    and a stale read only ever delays backpressure by one frame.
+    ``inflight`` covers enqueued-but-uncommitted frames: in overlapped
+    mode that includes rows still waiting in the ETL buffer, so the
+    admission window is end-to-end, not just receiver-internal."""
 
     __slots__ = ("sock", "addr", "client_id", "enqueued", "drained",
-                 "acked_sent", "drop_streak", "dropped_through", "alive")
+                 "acked_sent", "drop_streak", "dropped_pending", "alive")
 
     def __init__(self, sock: socket.socket, addr) -> None:
         self.sock = sock
@@ -201,7 +224,10 @@ class _Conn:
         self.drained = 0
         self.acked_sent = -1
         self.drop_streak = 0
-        self.dropped_through = 0
+        # seqs shed (overload or malformed) but not yet announced via a
+        # DROPPED frame; bounded by the notice cadence in _on_batch and
+        # flushed by _flush_acks on the next idle tick
+        self.dropped_pending: list[int] = []
         self.alive = True
 
     @property
@@ -213,7 +239,10 @@ class SpanFirehoseReceiver:
     """Threaded push receiver implementing the stream-source (tailer)
     protocol: ``poll()``/``backlog``/``dropped``/``close()`` plus the
     round-24 watermark convention, so ``StreamingTrainer.run`` and the
-    serve plane's VerdictIngestor consume it unchanged.
+    serve plane's VerdictIngestor consume it unchanged — and the
+    deferred-commit extension (``poll_deferred()``/``commit()``) the
+    overlapped ETL loop uses so the watermark only ever covers rows
+    that are actually in the ring.
 
     With ``space`` bound the receiver featurizes on its connection
     threads (``featurized = True``: ``poll()`` yields the same
@@ -256,10 +285,18 @@ class SpanFirehoseReceiver:
         self._lsock: socket.socket | None = None
         self._stop = threading.Event()
         # committed seq per client id — the dedup floor WELCOME reports
-        # and resume_from() restores.  Written by the poll thread,
-        # read by handler threads (GIL-atomic dict ops; a stale read
-        # only delays dedup of an already-counted frame by one poll).
+        # and resume_from() restores.  Written by the committing thread
+        # (poll()'s caller, or commit()'s in deferred mode), read by
+        # handler threads (GIL-atomic dict ops; a stale read only delays
+        # dedup of an already-counted frame by one poll).
         self._committed: dict[str, int] = {}
+        # drained-but-uncommitted batches: (token, [(conn, seq, t_enq)]).
+        # poll_deferred() appends, commit() pops — the window a kill may
+        # strike without losing anything, because nothing in here has
+        # been ACKed or counted into the watermark yet.
+        self._commit_lock = threading.Lock()
+        self._commit_token = 0
+        self._uncommitted: deque = deque()
         # highest ENQUEUED seq per client id: dedups a reconnect replay
         # of frames that are already in the buffer but not yet drained
         # (committed alone would admit them twice)
@@ -456,15 +493,16 @@ class SpanFirehoseReceiver:
             return
         inflight = conn.inflight
         if inflight >= self.hard_limit or len(self._out) >= self.max_buffered:
-            # Clipper admission: shed with accounting, notify producer
+            # Clipper admission: shed with accounting, notify producer.
+            # The DROPPED notice names the EXACT seqs shed — a range
+            # would also cover accepted-but-unACKed frames below it,
+            # and a client pruning those loses them on a receiver kill.
             with self._stats_lock:
                 self.dropped_total += 1
             conn.drop_streak += 1
-            conn.dropped_through = seq
+            conn.dropped_pending.append(seq)
             if conn.drop_streak == 1 or conn.drop_streak % 64 == 0:
-                self._send(conn, pack_frame(F_DROPPED, json.dumps(
-                    {"through": seq,
-                     "count": conn.drop_streak}).encode("utf-8")))
+                self._flush_dropped(conn)
             if conn.drop_streak >= self.evict_after:
                 self._evict(conn)
             return
@@ -480,10 +518,14 @@ class SpanFirehoseReceiver:
                             if flags & FLAG_JSONL
                             else self._decode_bucket(payload))
         except (ValueError, KeyError, TypeError, struct.error):
+            # counted ONCE: the dropped/stats aggregates already add
+            # malformed_total, so bumping dropped_total too would count
+            # this frame twice in the accounting identity.  The seq is
+            # still announced as shed so the client can prune it.
             with self._stats_lock:
                 self.malformed_total += 1
-                self.dropped_total += 1
-            conn.dropped_through = seq
+            conn.dropped_pending.append(seq)
+            self._flush_dropped(conn)
             return
         with self._stats_lock:
             self.batches_total += 1
@@ -569,13 +611,25 @@ class SpanFirehoseReceiver:
         return feats, nspans
 
     def _flush_acks(self, conn: _Conn) -> None:
-        """Push the committed watermark back to the producer.  Commit
-        advances when the train thread DRAINS a frame — an ACK is a
-        promise the spans reached the ring, not just a socket."""
+        """Push the committed watermark (and any unannounced shed seqs)
+        back to the producer.  Commit advances when the drained rows
+        LAND IN THE RING (poll() for same-thread consumers, commit() in
+        deferred mode) — an ACK is a promise the spans reached the
+        ring, not just a socket or an ETL queue."""
         wm = self._committed.get(conn.client_id, 0)
         if wm > conn.acked_sent:
             conn.acked_sent = wm
             self._send(conn, pack_frame(F_ACK, seq=wm))
+        if conn.dropped_pending:
+            self._flush_dropped(conn)
+
+    def _flush_dropped(self, conn: _Conn) -> None:
+        """Announce the exact shed seqs accumulated since the last
+        notice (bounded by the notice cadence, ≤ 64 between sends)."""
+        seqs, conn.dropped_pending = conn.dropped_pending, []
+        self._send(conn, pack_frame(F_DROPPED, json.dumps(
+            {"seqs": seqs,
+             "count": conn.drop_streak}).encode("utf-8")))
 
     def _send(self, conn: _Conn, frame: bytes) -> None:
         try:
@@ -616,34 +670,75 @@ class SpanFirehoseReceiver:
             return self.dropped_total + self.malformed_total
 
     def poll(self, max_items: int | None = None) -> list:
-        """Drain featurized items (or Buckets) for the train thread.
+        """Drain featurized items (or Buckets) AND commit them.
 
-        Draining COMMITS: the per-client watermark advances here, so an
+        Committing means: the per-client watermark advances, so an
         ACKed frame is by definition in the ring and a frame lost in a
         crash is by definition unACKed and will be replayed on
-        reconnect — no span is ever silently half-applied.
+        reconnect — no span is ever silently half-applied.  That
+        equivalence only holds if the caller ingests the returned items
+        on this same thread before the watermark can be observed (a
+        checkpoint cut, a WELCOME): the serial train loop and the
+        VerdictIngestor do.  A consumer that queues the items for
+        ANOTHER thread to ingest must use :meth:`poll_deferred` +
+        :meth:`commit` instead, or a kill between drain and ingest
+        loses the queued frames (ACKed and watermarked, never rung).
         """
+        out, token = self.poll_deferred(max_items)
+        self.commit(token)
+        return out
+
+    def poll_deferred(self, max_items: int | None = None
+                      ) -> tuple[list, int]:
+        """Drain WITHOUT committing: returns ``(items, token)``.  The
+        drained frames stay un-ACKed and outside the watermark until
+        ``commit(token)`` — call it only once the items are in the
+        ring.  Uncommitted frames survive a kill by replay: the client
+        still holds them pending, and a resumed watermark excludes
+        them, so the reconnect WELCOME solicits exactly the gap."""
         out = []
+        drained = []
         pop = self._out.popleft
-        now = time.monotonic()
         while self._out and (max_items is None or len(out) < max_items):
             try:
                 conn, seq, t_enq, item = pop()
             except IndexError:       # pragma: no cover - racing close()
                 break
-            conn.drained += 1
-            cur = self._committed.get(conn.client_id, 0)
-            if seq > cur:
-                self._committed[conn.client_id] = seq
-            lat = now - t_enq
-            self._lat.append(lat)
-            self._hist.observe(lat)
+            drained.append((conn, seq, t_enq))
             if isinstance(item, list):      # bulk frame: atomic unit
                 out.extend(item)
             else:
                 out.append(item)
+        with self._commit_lock:
+            self._commit_token += 1
+            token = self._commit_token
+            if drained:
+                self._uncommitted.append((token, drained))
+        return out, token
+
+    def commit(self, token: int) -> None:
+        """Advance per-client watermarks/ACK state for every batch
+        drained at or before ``token`` — the drained rows are now in
+        the ring.  Ingest→ring latency is observed here, so the
+        histogram covers the full path including any queue wait."""
+        batches = []
+        with self._commit_lock:
+            while self._uncommitted and self._uncommitted[0][0] <= token:
+                batches.append(self._uncommitted.popleft()[1])
+        if batches:
+            now = time.monotonic()
+            lats = []
+            for drained in batches:
+                for conn, seq, t_enq in drained:
+                    conn.drained += 1
+                    if seq > self._committed.get(conn.client_id, 0):
+                        self._committed[conn.client_id] = seq
+                    lats.append(now - t_enq)
+            with self._stats_lock:
+                self._lat.extend(lats)
+            for lat in lats:
+                self._hist.observe(lat)
         self._flush_obs()
-        return out
 
     def _flush_obs(self) -> None:
         """Delta-flush local counters into the obs registry — called at
@@ -690,9 +785,13 @@ class SpanFirehoseReceiver:
     def stats(self) -> dict:
         """The /healthz + RefreshResult-printout view: same shapes as the
         ``deeprest_wire_*`` registry series."""
-        lat = sorted(self._lat)
-        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else None
         with self._stats_lock:
+            # snapshot under the lock commit() appends under — sorted()
+            # iterating a deque another thread extends raises
+            # RuntimeError, which would take /healthz down with it
+            lat = sorted(self._lat)
+            p99 = (lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+                   if lat else None)
             return {
                 "spans": self.spans_total,
                 "batches": self.batches_total,
@@ -720,9 +819,12 @@ class WireClient:
     receiver's WELCOME watermark prunes the committed prefix and the
     rest is replayed, so a receiver kill mid-stream loses nothing and a
     stream resume double-counts nothing.  SLOWDOWN frames pause the
-    sender (``slowdown_pause_s``); DROPPED frames prune the shed window
-    (the receiver consciously dropped them — backpressure accounting,
-    not silent loss).
+    sender (``slowdown_pause_s``); DROPPED frames prune exactly the
+    seqs the receiver shed (backpressure accounting, not silent loss —
+    accepted frames stay pending until an ACK covers them).  If the
+    receiver stops ACKing entirely, the window is still bounded: an
+    ACK wait that times out sheds the oldest pending frames, counted
+    in ``timeout_shed``.
     """
 
     def __init__(self, address, client_id: str = "wire-client", *,
@@ -746,6 +848,7 @@ class WireClient:
         self.acked = 0
         self.slowdowns = 0
         self.server_dropped = 0
+        self.timeout_shed = 0
         self.reconnects = 0
         self.sent_batches = 0
         self._hdr = bytearray(HEADER_SIZE)
@@ -837,7 +940,19 @@ class WireClient:
         if len(self._pending) > self.pending_limit:
             # respect the receiver's pace: wait for ACKs before queueing
             # more (the client-side half of the backpressure contract)
-            self._await_acks(deadline_s=self.timeout_s)
+            if not self._await_acks(deadline_s=self.timeout_s):
+                # stalled-but-connected receiver: no ACKs are coming, so
+                # waiting again next send just adds a timeout per frame
+                # while the window grows without bound.  Bound it
+                # ourselves — shed the OLDEST unacked frames down to the
+                # same target _await_acks aims for, with accounting
+                # (the client-side mirror of the server's DROPPED
+                # semantics: counted shed, never silent growth).
+                target = self.pending_limit // 2
+                for s in sorted(self._pending)[:len(self._pending)
+                                               - target]:
+                    del self._pending[s]
+                    self.timeout_shed += 1
         return seq
 
     def flush(self, timeout_s: float | None = None) -> bool:
@@ -885,13 +1000,18 @@ class WireClient:
             self.slowdowns += 1
             time.sleep(self.slowdown_pause_s)
         elif ftype == F_DROPPED:
+            # prune EXACTLY the seqs the server shed: anything else in
+            # the window may be accepted-but-uncommitted, and pruning it
+            # here would strand it unreplayable if the receiver dies
+            # before committing
             try:
-                meta = json.loads(payload or b"{}")
-                through = int(meta.get("through", 0))
+                seqs = [int(s) for s in
+                        json.loads(payload or b"{}").get("seqs", ())]
             except (ValueError, TypeError):
-                through = 0
-            self.server_dropped += 1
-            self._prune(through)             # shed, acknowledged as shed
+                seqs = []
+            self.server_dropped += len(seqs)
+            for s in seqs:                   # shed, acknowledged as shed
+                self._pending.pop(s, None)
         elif ftype == F_BYE:
             raise ConnectionError("wire: server said BYE")
 

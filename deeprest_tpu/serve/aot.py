@@ -144,8 +144,22 @@ def export_aot(predictor, checkpoint_dir: str,
     # executable serializes as a thin reference to jit-compiled symbols
     # ("Symbols not found" at deserialize time) instead of embedding its
     # object code, and the artifact must be self-contained on any host.
+    # Disabling the flag is NOT enough: the cache keeps an in-memory
+    # layer, and a prior compile of the same program (the predictor's
+    # own warmup, with the cache live) leaves a cache-backed executable
+    # there that .compile() returns even with the flag off — reset it
+    # so the export compile is genuinely fresh.
     cache_was = jax.config.jax_enable_compilation_cache
     jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        # private API moved: exports still compile fresh whenever no
+        # prior cache-backed executable exists; load_aot's fallback
+        # path names any artifact that fails to deserialize
+        pass
     try:
         for rung in (tuple(rungs) if rungs is not None else eng.rungs):
             for kind, jitted in _programs(eng):

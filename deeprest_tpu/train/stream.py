@@ -1056,15 +1056,33 @@ class StreamingTrainer:
         depth = self.config.etl.queue_depth
         buf = _EtlBuffer(max_buckets=depth)
         stop = threading.Event()
+        # Deferred commit (data/wire.py): a source whose poll() would
+        # ACK-and-watermark at drain must not do so HERE — drained rows
+        # sit in buf until the train thread ingests them, and a
+        # checkpoint cut in that window would persist a watermark
+        # covering rows that are not in the ring (the client, already
+        # ACKed, has pruned them: a kill+resume would silently lose
+        # them).  Such sources expose poll_deferred()/commit(); the
+        # token rides the buffer and the train thread commits
+        # post-ingest.
+        poll_deferred = getattr(tailer, "poll_deferred", None)
+        commit = getattr(tailer, "commit", None)
+        deferred = callable(poll_deferred) and callable(commit)
 
         def etl_loop():
             # The tailer lives on THIS thread only: its counters cross to
             # the train loop through the buffer's lock-protected snapshot
             # (note_dropped), never as bare attribute reads across threads
-            # (graftlint TH001 found the original off-lock sharing).
+            # (graftlint TH001 found the original off-lock sharing) — the
+            # one sanctioned exception is commit(), which the wire
+            # receiver locks internally precisely so the train thread
+            # can call it.
             try:
                 while not stop.is_set():
-                    got = tailer.poll()
+                    if deferred:
+                        got, token = poll_deferred()
+                    else:
+                        got, token = tailer.poll(), None
                     buf.note_dropped(int(getattr(tailer, "dropped", 0)))
                     if got:
                         # One queue item per poll batch, kept atomic so the
@@ -1074,7 +1092,7 @@ class StreamingTrainer:
                         # (its own threads already did the ETL work).
                         buf.put(got if getattr(tailer, "featurized", False)
                                 else [self._featurize(b) for b in got],
-                                stop)
+                                stop, token)
                     elif not getattr(tailer, "backlog", False):
                         stop.wait(self.stream.poll_interval_s)
             except BaseException as exc:  # deterministic tailer failures etc.
@@ -1096,14 +1114,20 @@ class StreamingTrainer:
                         and time.monotonic() - t0 > deadline_s:
                     return
                 sw = obs_metrics.Stopwatch()
-                batch = buf.get(timeout=self.stream.poll_interval_s)
-                if batch:
+                item = buf.get(timeout=self.stream.poll_interval_s)
+                if item is not None:
+                    batch, token = item
                     # Only waits that produced data count as ETL stall —
                     # an idle timeout is the source's cadence, not the
                     # featurizer falling behind.
                     stall += sw.elapsed()
                     for feat in batch:
                         self._ingest_featurized(feat)
+                    if token is not None:
+                        # rows are in the ring: NOW the source may ACK
+                        # them and advance the watermark the next
+                        # checkpoint persists
+                        commit(token)
                 if self.ready():
                     yield self._finish_refresh(stall, buf.pending(),
                                                buf.dropped())
@@ -1356,36 +1380,43 @@ class _EtlBuffer:
     larger than the whole budget cannot deadlock.  Exceptions from the ETL
     thread are re-raised from ``get`` once the queue drains, so a
     deterministic tailer failure still surfaces to the caller.
+
+    Each batch carries an opaque ``token`` (None for sources without
+    deferred commit): a wire source's commit token, which the train
+    thread hands back to ``tailer.commit`` only AFTER the batch's rows
+    are in the ring — a batch discarded here (stop mid-put, kill) was
+    therefore never committed and will be replayed, never lost.
     """
 
     def __init__(self, max_buckets: int):
         self.max_buckets = max_buckets
         self._cv = threading.Condition()
-        self._batches: deque[list] = deque()
+        self._batches: deque[tuple[list, object]] = deque()
         self._buckets = 0
         self._dropped = 0          # tailer's malformed-line counter snapshot
         self._exc: BaseException | None = None
         self._closed = False
 
-    def put(self, batch: list, stop: threading.Event) -> None:
+    def put(self, batch: list, stop: threading.Event,
+            token=None) -> None:
         with self._cv:
             while self._buckets >= self.max_buckets and not stop.is_set():
                 self._cv.wait(0.05)
             if stop.is_set():
                 return
-            self._batches.append(batch)
+            self._batches.append((batch, token))
             self._buckets += len(batch)
             self._cv.notify_all()
 
-    def get(self, timeout: float) -> list | None:
+    def get(self, timeout: float) -> tuple[list, object] | None:
         with self._cv:
             if not self._batches and self._exc is None and not self._closed:
                 self._cv.wait(timeout)
             if self._batches:
-                batch = self._batches.popleft()
+                batch, token = self._batches.popleft()
                 self._buckets -= len(batch)
                 self._cv.notify_all()
-                return batch
+                return batch, token
             if self._exc is not None:
                 exc, self._exc = self._exc, None
                 raise exc

@@ -401,7 +401,10 @@ def test_etl_buffer_backpressure_and_error_propagation():
 
     def producer():
         blocked.set()
-        buf.put([4, 5], stop)                 # budget exhausted: must block
+        # budget exhausted: must block; a deferred-commit source's
+        # token rides the batch so the train thread can commit it
+        # only after ingest
+        buf.put([4, 5], stop, token=7)
 
     t = threading.Thread(target=producer)
     t.start()
@@ -409,10 +412,11 @@ def test_etl_buffer_backpressure_and_error_propagation():
     time.sleep(0.1)
     assert t.is_alive()                       # backpressure held it
     assert buf.pending() == 3
-    assert buf.get(timeout=1) == [1, 2, 3]    # drain → producer unblocks
+    # drain → producer unblocks
+    assert buf.get(timeout=1) == ([1, 2, 3], None)
     t.join(timeout=5)
     assert not t.is_alive()
-    assert buf.get(timeout=1) == [4, 5]
+    assert buf.get(timeout=1) == ([4, 5], 7)
     buf.fail(RuntimeError("etl died"))
     with pytest.raises(RuntimeError, match="etl died"):
         buf.get(timeout=1)

@@ -24,9 +24,10 @@ from deeprest_tpu.config import Config, FeaturizeConfig, ModelConfig, \
 from deeprest_tpu.data.featurize import CallPathSpace
 from deeprest_tpu.data.schema import Bucket, Span
 from deeprest_tpu.data.wire import (
-    F_BATCH, F_HELLO, F_WELCOME, HEADER_SIZE, MAGIC, MAX_FRAME_BYTES,
-    SpanFirehoseReceiver, WireClient, encode_bucket_payload, pack_frame,
-    parse_hostport, push_corpus, _HEADER,
+    F_BATCH, F_DROPPED, F_HELLO, F_WELCOME, HEADER_SIZE, MAGIC,
+    MAX_FRAME_BYTES, SpanFirehoseReceiver, WireClient,
+    encode_bucket_payload, pack_frame, parse_hostport, push_corpus,
+    _HEADER,
 )
 from deeprest_tpu.workload import normal_scenario, simulate_corpus
 
@@ -195,6 +196,137 @@ def test_wire_jsonl_bulk_frame_is_one_atomic_item():
         ref_cols, ref_vals = ref_space.extract_sparse(b.traces)
         np.testing.assert_array_equal(row[0], ref_cols)
         np.testing.assert_array_equal(row[1], ref_vals)
+
+
+# ---------------------------------------------------------------------------
+# deferred commit (the overlapped-ETL contract) + shed accounting
+
+
+def test_poll_deferred_commit_gates_watermark_and_acks():
+    """poll_deferred() must hand out items WITHOUT advancing the
+    watermark or releasing ACKs — only commit(token) does, once the
+    caller has the rows in the ring.  This is what keeps the overlapped
+    ETL loop's checkpoint cuts honest: a persisted watermark can never
+    cover a frame still waiting in the featurize queue."""
+    corpus = _corpus(3)
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    client = WireClient(rx.address, client_id="defer").connect()
+    try:
+        for b in corpus:
+            client.send_bucket(b)
+        deadline = time.monotonic() + 30
+        while rx.stats()["batches"] < 3:
+            assert time.monotonic() < deadline, rx.stats()
+            time.sleep(0.002)
+        items, token = rx.poll_deferred()
+        assert len(items) == 3
+        assert rx.ingest_watermark()["clients"].get("defer", 0) == 0
+        # nothing is ACKed yet either: a flush cannot complete
+        assert client.flush(timeout_s=0.3) is False
+        rx.commit(token)
+        assert rx.ingest_watermark()["clients"]["defer"] == 3
+        assert client.flush(timeout_s=10)
+        assert client.acked == 3
+        assert rx.stats()["p99_ingest_s"] is not None
+    finally:
+        client.close()
+        rx.close()
+
+
+def test_dropped_notice_prunes_only_named_seqs():
+    """A DROPPED notice names the exact shed seqs; the client must keep
+    every other pending frame replayable — pruning a range would also
+    discard accepted-but-uncommitted frames, unrecoverable if the
+    receiver dies before committing them."""
+    client = WireClient(("127.0.0.1", 1))
+    client._pending = {1: (0, b"a"), 2: (0, b"b"), 3: (0, b"c")}
+    client._handle(F_DROPPED, 0, json.dumps(
+        {"seqs": [2], "count": 1}).encode("utf-8"))
+    assert sorted(client._pending) == [1, 3]
+    assert client.server_dropped == 1
+
+
+def test_malformed_frame_counted_once_and_announced():
+    """A frame that fails decode lands in the accounting exactly once
+    (the dropped aggregate already includes malformed_total), and its
+    seq is announced via DROPPED so the sender can prune it instead of
+    retrying a frame that can never decode."""
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    try:
+        s = socket.create_connection(rx.address, timeout=5)
+        s.sendall(pack_frame(F_HELLO, b"{}"))
+        hdr = s.recv(HEADER_SIZE, socket.MSG_WAITALL)
+        magic, ftype, _, length, _ = _HEADER.unpack(hdr)
+        assert (magic, ftype) == (MAGIC, F_WELCOME)
+        if length:
+            s.recv(length, socket.MSG_WAITALL)
+        # valid framing, garbage sub-framing: decode raises, conn lives
+        s.sendall(pack_frame(F_BATCH, b"\x00\x00\x00\x02{}", seq=1))
+        deadline = time.monotonic() + 10
+        while rx.stats()["dropped"] < 1:
+            assert time.monotonic() < deadline, rx.stats()
+            time.sleep(0.005)
+        assert rx.stats()["dropped"] == 1       # once, not double
+        hdr = s.recv(HEADER_SIZE, socket.MSG_WAITALL)
+        magic, ftype, _, length, _ = _HEADER.unpack(hdr)
+        assert (magic, ftype) == (MAGIC, F_DROPPED)
+        meta = json.loads(s.recv(length, socket.MSG_WAITALL))
+        assert meta["seqs"] == [1]
+        s.close()
+    finally:
+        rx.close()
+
+
+def test_stalled_receiver_bounds_client_pending_with_shed_accounting():
+    """A receiver that accepts frames but never commits sends no ACKs;
+    the client's pending window must stay bounded anyway — the ACK wait
+    times out and sheds the oldest frames with accounting, instead of
+    one full timeout per send on top of unbounded growth."""
+    (bucket,) = _corpus(1)
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    client = WireClient(rx.address, client_id="stall",
+                        pending_limit=4, timeout_s=0.1).connect()
+    try:
+        for _ in range(12):
+            client.send_bucket(bucket)
+        assert client.timeout_shed > 0
+        assert len(client._pending) <= client.pending_limit + 1
+    finally:
+        client.close()
+        rx.close()
+
+
+def test_stats_concurrent_with_commit_never_raises():
+    """stats() (the /healthz path) and the committing thread touch the
+    same latency deque; iterating it off-lock raises RuntimeError
+    ('deque mutated during iteration') under load.  Hammer stats()
+    while draining a pushed corpus — no exception may escape."""
+    corpus = _corpus(4) * 50
+    rx = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    errs: list = []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                rx.stats()
+        except Exception as exc:               # pragma: no cover
+            errs.append(exc)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        pusher = threading.Thread(target=push_corpus,
+                                  args=(rx.address, corpus),
+                                  daemon=True)
+        pusher.start()
+        _drain(rx, len(corpus))
+        pusher.join(timeout=30)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        rx.close()
+    assert not errs, errs
 
 
 # ---------------------------------------------------------------------------

@@ -136,6 +136,60 @@ def test_receiver_kill_midstream_then_clean_reconnect():
     _await_census(baseline)
 
 
+def test_kill_between_drain_and_ingest_loses_nothing():
+    """The overlapped ETL loop drains with poll_deferred() and commits
+    only after the rows land in the ring.  Kill the receiver while
+    frames sit drained-but-uncommitted (the ETL-queue window): the
+    watermark must not cover them, the client must still hold them
+    pending, and the reconnect replay must deliver exactly the gap —
+    the window an ACK-at-drain design would silently lose."""
+    baseline = _census()
+    corpus = _corpus(12)
+    expected = _metrics_rows(corpus)
+
+    rx1 = SpanFirehoseReceiver("127.0.0.1", 0, space=_space()).start()
+    port = rx1.address[1]
+    client = WireClient(rx1.address, client_id="chaos-defer",
+                        pending_limit=200).connect()
+    for b in corpus[:8]:
+        client.send_bucket(b)
+    deadline = time.monotonic() + 30
+    while rx1.stats()["batches"] < 8:
+        assert time.monotonic() < deadline, rx1.stats()
+        time.sleep(0.002)
+    items = _drain_frames_exactly(rx1, 4)      # poll() = drain + commit
+    deferred, _token = rx1.poll_deferred()     # drained, NOT committed
+    assert len(deferred) == 4
+    wm = rx1.ingest_watermark()
+    assert wm == {"kind": "wire_seq", "clients": {"chaos-defer": 4}}, \
+        "deferred drain leaked into the watermark before ingest"
+    rx1.close()    # KILL: frames 5..8 die in the "ETL queue" — but
+    #                uncommitted, so the client still has them pending
+
+    rx2 = SpanFirehoseReceiver("127.0.0.1", port, space=_space()).start()
+    rx2.resume_from(wm)
+    late: list = []
+    drainer = threading.Thread(
+        target=lambda: late.extend(
+            _drain_frames(rx2, 12 - len(items), deadline_s=40)),
+        daemon=True)
+    drainer.start()
+    for b in corpus[8:]:
+        client.send_bucket(b)
+    assert client.flush(timeout_s=30)
+    drainer.join(timeout=40)
+    assert not drainer.is_alive(), "drainer wedged short of 12 buckets"
+    items += late
+    assert client.reconnects >= 1
+    client.close()
+    rx2.close()
+
+    got = [metrics_row for (_row, metrics_row) in items]
+    assert got == expected, \
+        "drain-vs-ingest kill window lost or double-applied a bucket"
+    _await_census(baseline)
+
+
 def test_backpressure_storm_accounts_for_every_frame():
     """Fire at a tiny admission window with nobody draining: SLOWDOWN
     reaches the producer, the drop band engages, and when the dust
