@@ -45,13 +45,26 @@ from typing import Callable, Iterable, Iterator
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
-    """One rule violation at one source location."""
+    """One rule violation at one source location.  ``related`` carries
+    secondary witness sites — ``(path, line, col, message)`` tuples —
+    for rules whose evidence spans two locations (the RC pack's
+    two-site race witnesses); SARIF renders them as
+    ``relatedLocations``."""
 
     path: str          # posix path relative to the lint root
     line: int
     col: int
     rule: str
     message: str
+    related: tuple = ()
+
+    def __post_init__(self):
+        # normalize (the findings cache round-trips through JSON, which
+        # revives the witness tuples as lists)
+        if not isinstance(self.related, tuple) or any(
+                not isinstance(r, tuple) for r in self.related):
+            object.__setattr__(self, "related", tuple(
+                tuple(r) for r in self.related))
 
     def key(self) -> str:
         """Baseline identity: line numbers are EXCLUDED so unrelated
@@ -275,7 +288,8 @@ def all_rules() -> dict[str, Rule]:
 
     for pack in ("rules_jax", "rules_threading", "rules_hygiene",
                  "rules_obs", "rules_data", "rules_lifecycle",
-                 "rules_exceptions", "rules_fleet", "rules_wire"):
+                 "rules_exceptions", "rules_fleet", "rules_wire",
+                 "rules_races"):
         importlib.import_module(f"deeprest_tpu.analysis.{pack}")
     return dict(_REGISTRY)
 
@@ -379,18 +393,35 @@ class LintResult:
 
 def analyze_project(project: Project,
                     rules: Iterable[Rule] | None = None,
+                    timings: dict | None = None,
                     ) -> tuple[list[Finding], int]:
     """Run meta checks + rule packs and apply in-code suppressions:
     ``(kept findings, suppressed count)``.  This is the (expensive,
     content-determined) half the incremental cache stores — the
     baseline split happens in :func:`apply_baseline` because the
-    baseline file can change independently of the tree."""
+    baseline file can change independently of the tree.
+
+    ``timings``, when given, is filled with per-pack wall seconds
+    keyed by the two-letter pack prefix (plus ``meta``).  Shared lazy
+    infrastructure (the call graph, the value-flow and lockset
+    fixpoints) is charged to the FIRST pack that touches it — the
+    honest cost of running that pack alone."""
+    import time as _time
+
     rule_objs = (list(rules) if rules is not None
                  else list(all_rules().values()))
+    t0 = _time.perf_counter()
     raw: list[Finding] = _meta_findings(
         project, {r.id for r in rule_objs} | set(all_rules()), rule_objs)
+    if timings is not None:
+        timings["meta"] = _time.perf_counter() - t0
     for rule in rule_objs:
+        t0 = _time.perf_counter()
         raw.extend(rule.run(project))
+        if timings is not None:
+            pack = rule.id[:2]
+            timings[pack] = (timings.get(pack, 0.0)
+                             + _time.perf_counter() - t0)
 
     suppressed = 0
     kept: list[Finding] = []

@@ -23,8 +23,9 @@ def render_text(result: LintResult) -> str:
     return "\n".join(lines)
 
 
-def render_json(result: LintResult) -> str:
-    return json.dumps({
+def render_json(result: LintResult,
+                timings: dict | None = None) -> str:
+    payload = {
         "version": 1,
         "files": result.files,
         "counts": {
@@ -34,7 +35,24 @@ def render_json(result: LintResult) -> str:
         },
         "findings": [f.to_dict() for f in result.findings],
         "baselined": [f.to_dict() for f in result.baselined],
-    }, indent=2, sort_keys=True)
+    }
+    if timings is not None:
+        payload["timings"] = {k: round(v, 4)
+                              for k, v in timings.items()}
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+def render_timings(timings: dict) -> str:
+    """``lint --timings``: the per-pack wall-time breakdown, slowest
+    first.  Lazy shared infrastructure (call graph, value-flow and
+    lockset fixpoints) is charged to the first pack that touches it."""
+    total = sum(timings.values())
+    lines = ["pack timings (wall):"]
+    for key, secs in sorted(timings.items(),
+                            key=lambda kv: (-kv[1], kv[0])):
+        lines.append(f"  {key:<6} {secs * 1000.0:8.1f} ms")
+    lines.append(f"  {'total':<6} {total * 1000.0:8.1f} ms")
+    return "\n".join(lines)
 
 
 def render_rules() -> str:
@@ -65,18 +83,32 @@ def render_sarif(result: LintResult) -> str:
         if rule is not None and rule.guards:
             meta["help"] = {"text": f"guards: {rule.guards}"}
         rules_meta.append(meta)
-    results = [{
-        "ruleId": f.rule,
-        "level": "error",
-        "message": {"text": f.message},
-        "locations": [{
-            "physicalLocation": {
-                "artifactLocation": {"uri": f.path},
-                "region": {"startLine": max(1, f.line),
-                           "startColumn": f.col + 1},
-            },
-        }],
-    } for f in result.findings]
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.related:
+            # two-site race witnesses (the RC pack): the second access
+            # site annotates the same review inline
+            entry["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": rpath},
+                    "region": {"startLine": max(1, rline),
+                               "startColumn": rcol + 1},
+                },
+                "message": {"text": rmsg},
+            } for rpath, rline, rcol, rmsg in f.related]
+        results.append(entry)
     return json.dumps({
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),

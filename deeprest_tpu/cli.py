@@ -1288,7 +1288,8 @@ def cmd_lint(args) -> int:
         load_baseline, load_project, render_json, render_rules,
         render_sarif, render_suppressions_json,
         render_suppressions_markdown, render_suppressions_text,
-        render_text, save_baseline, suppression_inventory,
+        render_text, render_timings, save_baseline,
+        suppression_inventory,
     )
 
     if args.list_rules:
@@ -1350,7 +1351,25 @@ def cmd_lint(args) -> int:
     except ValueError as exc:
         print(f"lint: {exc}")
         return 2
-    if args.no_cache:
+    timings: dict | None = None
+    if getattr(args, "timings", False):
+        # a cache hit stores no per-pack times, so --timings always
+        # runs the analysis fresh (that is the number being asked for)
+        import time as _time
+
+        from deeprest_tpu.analysis import (
+            analyze_project, apply_baseline,
+        )
+
+        timings = {}
+        t0 = _time.perf_counter()
+        project = load_project(paths, jobs=jobs)
+        timings["parse"] = _time.perf_counter() - t0
+        kept, suppressed = analyze_project(project, rules=rules,
+                                           timings=timings)
+        result = apply_baseline(kept, suppressed, len(project.files),
+                                baseline_keys)
+    elif args.no_cache:
         result = lint_paths(paths, rules=rules,
                             baseline_keys=baseline_keys, jobs=jobs)
     else:
@@ -1389,9 +1408,11 @@ def cmd_lint(args) -> int:
     if args.format == "sarif":
         print(render_sarif(result))
     elif args.format == "json":
-        print(render_json(result))
+        print(render_json(result, timings=timings))
     else:
         print(render_text(result) + scope_note)
+        if timings is not None:
+            print(render_timings(timings))
     return 1 if result.findings else 0
 
 
@@ -1970,6 +1991,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bypass the incremental lint cache (parse "
                         "pickles + whole-tree findings payloads under "
                         ".graftlint_cache/)")
+    p.add_argument("--timings", action="store_true",
+                   help="print the per-pack wall-time breakdown (text "
+                        "trailer or JSON 'timings' key); implies a "
+                        "fresh uncached run — a cache hit has no "
+                        "per-pack cost to report")
     p.add_argument("--cache-dir", default=".graftlint_cache",
                    metavar="DIR",
                    help="incremental cache root (default: "

@@ -785,6 +785,14 @@ class SpanFirehoseReceiver:
     def stats(self) -> dict:
         """The /healthz + RefreshResult-printout view: same shapes as the
         ``deeprest_wire_*`` registry series."""
+        # deliberately OUTSIDE _stats_lock: _out is the lock-free
+        # hot-path deque (single ingest writer, GIL-atomic len) and
+        # connections acquires _conns_lock — neither belongs inside this
+        # critical section (graftrace RC001 reads the incidental
+        # placement as guard intent, and nesting _conns_lock under
+        # _stats_lock is a lock-order hazard for free)
+        pending = len(self._out)
+        conns = self.connections
         with self._stats_lock:
             # snapshot under the lock commit() appends under — sorted()
             # iterating a deque another thread extends raises
@@ -799,8 +807,8 @@ class SpanFirehoseReceiver:
                 "backpressure": self.backpressure_total,
                 "duplicates": self.duplicates_total,
                 "evictions": self.evictions_total,
-                "connections": self.connections,
-                "pending": len(self._out),
+                "connections": conns,
+                "pending": pending,
                 "memo_hit_rate": (self.memo_hits
                                   / max(1, self.memo_hits
                                         + self.memo_misses)),

@@ -299,11 +299,18 @@ class EngineReplica:
         from deeprest_tpu.serve.batcher import MicroBatcher
 
         with self._lock:
+            # ONE critical section from the batching/backend read to the
+            # publish (graftrace RC003): two concurrent reloads — or a
+            # reload racing set_batching — would otherwise both read the
+            # same `old`, and the loser's published stack (batcher and
+            # all) retires silently, never detached or closed.  The
+            # MicroBatcher built here touches only the unpublished
+            # `fresh`, so holding the lock across it cannot invert
+            # lock order.
             batching = self._batching
             old = self._backend
-        if batching is not None and fresh.batcher is None:
-            fresh.attach_batcher(MicroBatcher(fresh.ladder, batching))
-        with self._lock:
+            if batching is not None and fresh.batcher is None:
+                fresh.attach_batcher(MicroBatcher(fresh.ladder, batching))
             self._backend = fresh
         old_b = old.batcher
         if old_b is not None and old_b is not fresh.batcher:

@@ -970,7 +970,21 @@ class ReplicaRouter:
                     r.close()
                 raise
         with self._lock:
-            self._replicas.extend(fresh)
+            # revalidate before the act (graftrace RC003): the length
+            # check at the top ran under an EARLIER acquire, and a
+            # concurrent scale_to may have grown the plane while the new
+            # stacks were building off-lock — blindly extending would
+            # overshoot the target.  Cap at the room actually left.
+            room = max(0, n - len(self._replicas))
+            publish, surplus = fresh[:room], fresh[room:]
+            self._replicas.extend(publish)
+        for r in surplus:
+            # unpublished process workers own live subprocesses; thread
+            # replicas may share a cloned stack with a published
+            # survivor, so they are dropped (GC reclaims unshared
+            # stacks), never closed
+            if not callable(getattr(r, "backend", None)):
+                r.close()
         return n
 
     def note_autoscaler(self, decision: dict) -> None:

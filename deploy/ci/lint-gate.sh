@@ -6,13 +6,17 @@
 #      PR changed (the whole project is still parsed so the call graph
 #      and the graftflow value-flow engine keep their interprocedural
 #      context, incl. QT001's int8-escape tracking across call chains
-#      into ops//serve/) — emitted as SARIF 2.1.0 (lint.sarif) so the
-#      review system annotates findings inline on the diff.  The warm
+#      into ops//serve/, and the graftrace lockset engine keeps its
+#      entry-lock summaries and thread-root inventory for the RC race
+#      pack — RC findings carry their two-site witness as SARIF
+#      relatedLocations, annotated alongside the primary site) —
+#      emitted as SARIF 2.1.0 (lint.sarif) so the review system
+#      annotates findings inline on the diff.  The warm
 #      .graftlint_cache/ makes the re-runs on push cheap; CI runners
 #      that persist a workspace get the same win.
 #   2. The tier-1 test suite (the exact ROADMAP.md command): the lint
 #      self-check (tests/test_lint_clean.py) rides inside it, pinning
-#      the EMPTY baseline and the 10s lint budget.
+#      the EMPTY baseline and the 18s lint budget.
 #
 # Usage: bash deploy/ci/lint-gate.sh   (or: make lint-gate)
 set -euo pipefail

@@ -2712,6 +2712,372 @@ def test_registry_audit_every_rule_cited_and_fixtured():
 
 
 # ---------------------------------------------------------------------------
+# RC pack: graftrace interprocedural lockset race detection
+# (analysis/locksets.py + analysis/rules_races.py)
+
+
+# The round-24 incident shape: commit() extends the latency deque under
+# _stats_lock while stats() iterates it with no lock.  The mutation is
+# an ast.Load plus a method call — invisible to TH001/TH004's
+# written_outside_init, which is why the RC pack exists.
+RC001_BAD = """
+import collections
+import threading
+
+
+class Receiver:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._lat = collections.deque(maxlen=4096)
+
+    def commit(self, batch):
+        with self._stats_lock:
+            self._lat.extend(batch)
+
+    def stats(self):
+        return sorted(self._lat)
+"""
+
+RC001_GOOD = """
+import collections
+import threading
+
+
+class Receiver:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._lat = collections.deque(maxlen=4096)
+
+    def commit(self, batch):
+        with self._stats_lock:
+            self._lat.extend(batch)
+
+    def stats(self):
+        with self._stats_lock:
+            return sorted(self._lat)
+"""
+
+
+def test_rc001_pair():
+    assert_pair("RC001", RC001_BAD, RC001_GOOD)
+
+
+def test_rc001_two_site_witness_and_guard_inference():
+    f = findings_for("RC001", RC001_BAD)[0]
+    assert (f.line, f.col) == (16, 22)          # the unguarded read
+    assert "inferred guard self._stats_lock covers 1/2 accesses" \
+        in f.message
+    assert "external caller" in f.message       # both call chains inline
+    # the guarded witness site rides in Finding.related → SARIF
+    assert f.related == (("mod.py", 13, 12,
+                          "guarded witness: commit() holds "
+                          "self._stats_lock"),)
+
+
+def test_rc001_fully_unguarded_attr_is_out_of_scope():
+    # the RacerD precision trade: no guarded access anywhere means no
+    # evidence of guard intent — the single-writer / GIL-atomic designs
+    # (SpanFirehoseReceiver._out) stay silent by construction
+    src = RC001_BAD.replace(
+        "        with self._stats_lock:\n"
+        "            self._lat.extend(batch)\n",
+        "        self._lat.extend(batch)\n")
+    assert not findings_for("RC001", src)
+
+
+RC002_BAD = """
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._a:
+            self.total += n
+
+    def drain(self):
+        with self._b:
+            self.total = 0
+"""
+
+RC002_GOOD = """
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._a:
+            self.total += n
+
+    def drain(self):
+        with self._a:
+            self.total = 0
+"""
+
+
+def test_rc002_pair():
+    assert_pair("RC002", RC002_BAD, RC002_GOOD)
+
+
+RC_CONDITION_ALIAS = """
+import threading
+
+
+class Replica:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.outstanding = 0
+
+    def begin(self, n):
+        with self._lock:
+            self.outstanding += n
+
+    def end(self, n):
+        with self._cv:
+            self.outstanding -= n
+            self._cv.notify_all()
+"""
+
+
+def test_rc002_condition_wrapping_the_lock_aliases_it():
+    """threading.Condition(self._lock) WRAPS the lock: `with self._cv`
+    and `with self._lock` take the same mutex, so the EngineReplica
+    _cv/_lock pair is ONE guard — the first whole-repo run's two false
+    positives, fixed in the engine rather than suppressed."""
+    assert not findings_for("RC002", RC_CONDITION_ALIAS)
+    assert not findings_for("RC001", RC_CONDITION_ALIAS)
+
+
+# The round-23 incident shape: dispatch reads the params tree under the
+# engine lock, releases, and acts on the stale snapshot under a fresh
+# acquire — minting a second C++ dispatch-cache signature when a spill
+# interleaves.
+RC003_BAD = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.params = {}
+
+    def swap(self, fresh):
+        with self._lock:
+            self.params = fresh
+
+    def dispatch(self, x):
+        with self._lock:
+            tree = self.params
+        sig = trace_signature(tree, x)
+        with self._lock:
+            self.params = retrace(sig)
+"""
+
+RC003_GOOD = """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.params = {}
+
+    def swap(self, fresh):
+        with self._lock:
+            self.params = fresh
+
+    def dispatch(self, x):
+        with self._lock:
+            tree = self.params
+            sig = trace_signature(tree, x)
+            self.params = retrace(sig)
+"""
+
+
+def test_rc003_pair():
+    assert_pair("RC003", RC003_BAD, RC003_GOOD)
+
+
+def test_rc003_revalidation_in_the_act_section_is_silent():
+    # the other sanctioned remediation (ReplicaRouter.scale_to): re-read
+    # the attribute inside the act section before writing
+    src = RC003_BAD.replace(
+        "        with self._lock:\n"
+        "            self.params = retrace(sig)\n",
+        "        with self._lock:\n"
+        "            if self.params is tree:\n"
+        "                self.params = retrace(sig)\n")
+    assert not findings_for("RC003", src)
+
+
+def test_rc003_check_site_rides_in_related():
+    f = findings_for("RC003", RC003_BAD)[0]
+    assert f.line == 19                          # the act (stale write)
+    assert f.related[0][1] == 16                 # the check (locked read)
+    assert "released before the act" in f.related[0][3]
+
+
+RC004_BAD = """
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = []
+
+    def add(self, item):
+        with self._lock:
+            self._slots.append(item)
+
+    def snapshot(self):
+        with self._lock:
+            return self._slots
+"""
+
+RC004_GOOD = """
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = []
+
+    def add(self, item):
+        with self._lock:
+            self._slots.append(item)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._slots)
+"""
+
+
+def test_rc004_pair():
+    assert_pair("RC004", RC004_BAD, RC004_GOOD)
+
+
+TH_RC_PIN = """
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.mode = "idle"
+
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        while True:
+            self.count += 1
+
+    def healthz(self):
+        return self.count
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def snapshot(self):
+        return self.total
+"""
+
+
+def test_th001_th004_verdicts_unchanged_with_rc_pack_live():
+    """These verdicts were captured BEFORE the RC pack landed and must
+    reproduce bit-for-bit (path, line, col, rule, full message): the
+    lockset engine shares rules_threading's factories and runs the TH
+    rules internally for its ownership ledger, so any drift here means
+    the pack changed the rules it was built to complement."""
+    expected = {
+        "TH001": [
+            ("mod.py", 16, 0, "TH001",
+             "Plane.count is written in _worker() (thread-side, no "
+             "lock) and accessed in healthz() line 19 (no lock) — a "
+             "data race between the class's threads; hold self._lock "
+             "around every access")],
+        "TH004": [
+            ("mod.py", 32, 0, "TH004",
+             "Ledger.total is read in snapshot() without the class "
+             "lock, but add() line 29 guards the same attribute with "
+             "self._lock — one unguarded access defeats the lock; hold "
+             "it on every access")],
+    }
+    for rid, want in expected.items():
+        result = lint_sources({"mod.py": TH_RC_PIN},
+                              rules=[all_rules()[rid]])
+        got = [(f.path, f.line, f.col, f.rule, f.message)
+               for f in result.findings]
+        assert got == want, f"{rid} verdict drifted: {got}"
+
+
+def test_rc_never_double_reports_a_th_owned_site():
+    """One owner per site: Plane.count is TH001's, Ledger.total is
+    TH004's — a full-registry run reports each exactly once, with no RC
+    finding stacked on top."""
+    result = lint_sources({"mod.py": TH_RC_PIN})
+    rules = sorted(f.rule for f in result.findings)
+    assert rules == ["TH001", "TH004"], rules
+
+
+def test_sarif_related_locations_for_two_site_witness():
+    from deeprest_tpu.analysis import render_sarif
+
+    result = lint_sources({"mod.py": RC001_BAD},
+                          rules=[all_rules()["RC001"]])
+    payload = json.loads(render_sarif(result))
+    res = payload["runs"][0]["results"][0]
+    assert res["ruleId"] == "RC001"
+    rel = res["relatedLocations"][0]
+    assert rel["physicalLocation"]["artifactLocation"]["uri"] == "mod.py"
+    assert rel["physicalLocation"]["region"]["startLine"] == 13
+    assert rel["physicalLocation"]["region"]["startColumn"] == 13
+    assert "holds self._stats_lock" in rel["message"]["text"]
+    # findings without a witness carry no relatedLocations key at all
+    plain = lint_sources({"mod.py": "import os\nprint(1)\n"})
+    payload = json.loads(render_sarif(plain))
+    assert all("relatedLocations" not in r
+               for r in payload["runs"][0]["results"])
+
+
+def test_cli_lint_timings(tmp_path, capsys):
+    from deeprest_tpu.cli import main
+
+    f = tmp_path / "ok.py"
+    f.write_text("print(1)\n")
+    assert main(["lint", str(f), "--timings"]) == 0
+    out = capsys.readouterr().out
+    assert "pack timings (wall):" in out
+    assert "total" in out
+
+    assert main(["lint", str(f), "--timings", "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert "RC" in payload["timings"]          # the new pack is charged
+    assert "parse" in payload["timings"]
+    assert all(v >= 0 for v in payload["timings"].values())
+
+
+# ---------------------------------------------------------------------------
 # incremental lint cache (analysis/cache.py)
 
 
@@ -2789,6 +3155,35 @@ def test_cache_suppression_edit_invalidates(tmp_path):
         "import os\n")
     after, _ = lint_paths_cached([str(proj)], cache_dir=cache_dir)
     assert not after.findings and after.suppressed_count == 1
+
+
+def test_cache_pack_version_covers_new_pack_modules(tmp_path, monkeypatch):
+    """The pack digest walks analysis/*.py by directory listing, so a
+    NEW module (this round: locksets.py + rules_races.py) shifts it
+    without a hand-bumped constant — and a shifted digest refuses every
+    stored result."""
+    import os
+
+    from deeprest_tpu.analysis import cache as cache_mod
+
+    here = os.path.dirname(os.path.abspath(cache_mod.__file__))
+    names = {n for n in os.listdir(here) if n.endswith(".py")}
+    assert {"locksets.py", "rules_races.py"} <= names
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("import os\n")
+    cache_dir = str(tmp_path / "cache")
+    cold, _ = cache_mod.lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    warm, c2 = cache_mod.lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert c2.result_hit
+    # simulate the NEXT new pack file: a different digest must miss the
+    # stored result and recompute to the same verdicts
+    monkeypatch.setattr(cache_mod, "_PACK_VERSION", "0" * 16)
+    miss, c3 = cache_mod.lint_paths_cached([str(proj)], cache_dir=cache_dir)
+    assert not c3.result_hit
+    assert ([(f.path, f.line, f.rule) for f in miss.findings]
+            == [(f.path, f.line, f.rule) for f in cold.findings])
 
 
 # ---------------------------------------------------------------------------

@@ -11,8 +11,9 @@ tier-1 here — the same way a racy native featurizer change fails the
 tsan selftest.
 
 Budget: the whole run — parse, the whole-program call graph, and every
-rule pack (RS/EX's path-sensitive walkers included) over ~75 files —
-must stay under 10 s so it remains a tier-1 test.
+rule pack (RS/EX's path-sensitive walkers and the RC lockset fixpoint
+included) over ~90 files — must stay under 18 s so it remains a
+tier-1 test.
 
 Also pinned here: ANALYSIS.md's generated suppression table matches the
 live in-code inventory exactly (doc-vs-code drift is a failure).
@@ -42,14 +43,17 @@ def test_package_lints_clean_with_empty_baseline():
     assert result.files >= 50, "package walk looks truncated"
     assert not result.findings, "\n" + render_text(result)
     elapsed = time.monotonic() - t0
-    # Budget recalibrated round 24 (10s -> 15s): profiled, the cost is
-    # ast.walk linear in package size (87 files; ~6s cold standalone,
-    # ~10s late in a suite run under a grown heap), no pathological
-    # pack.  The guard's job is catching a super-linear rule — one
-    # quadratic pass still blows 15s immediately.
-    assert elapsed < 15.0, (
-        f"lint self-check took {elapsed:.1f}s — over the 15s tier-1 "
-        "budget; profile the rule packs before merging")
+    # Budget recalibrated round 25 (15s -> 18s): the RC lockset pack
+    # (fixpoint entry-lock summaries + the TH ownership ledger) adds
+    # ~1.6s — measured 7.6s cold standalone over 89 files (was ~6s
+    # round 24; `lint --timings` attributes the delta to RC/TH), so the
+    # late-in-suite grown-heap figure moves from ~10s toward ~12s.  The
+    # guard's job is catching a super-linear rule — one quadratic pass
+    # still blows 18s immediately.
+    assert elapsed < 18.0, (
+        f"lint self-check took {elapsed:.1f}s — over the 18s tier-1 "
+        "budget; profile the rule packs (`lint --timings`) before "
+        "merging")
 
 
 def test_suppressions_all_carry_reasons():

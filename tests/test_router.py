@@ -420,6 +420,108 @@ def test_scale_to_grows_and_shrinks(pred8, traffic):
         router.close()
 
 
+class _SwapBatcher:
+    def __init__(self):
+        self.closed = 0
+
+    def close(self):
+        self.closed += 1
+
+
+class _SwapStack:
+    """Minimal backend surface for reload_backend: a batcher slot."""
+
+    def __init__(self):
+        self.batcher = _SwapBatcher()
+
+    def attach_batcher(self, b):
+        self.batcher = b
+
+
+def test_reload_backend_swap_chain_under_concurrent_reloads():
+    """Dynamic twin of the graftrace RC003 finding on
+    EngineReplica.reload_backend: the old shape read ``old`` under one
+    acquire and published under ANOTHER, so two concurrent reloads could
+    both read the same ``old`` — the loser's published stack retired
+    silently, its batcher never detached or closed.  With the single
+    critical section the published stacks form an exact swap chain:
+    every retired stack's batcher is closed exactly once, and only the
+    final stack's batcher survives."""
+    base = _SwapStack()
+    replica = EngineReplica(base, name="swap")
+    fresh = [_SwapStack() for _ in range(120)]
+    batchers = {id(s): s.batcher for s in [base] + fresh}
+
+    def worker(chunk):
+        for s in chunk:
+            replica.reload_backend(s)
+
+    threads = [threading.Thread(target=worker, args=(fresh[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    final = replica.backend()
+    assert batchers[id(final)].closed == 0, \
+        "the live stack's batcher must survive"
+    retired = [b for sid, b in batchers.items() if sid != id(final)]
+    assert sorted(b.closed for b in retired) == [1] * len(retired), \
+        "every retired stack must be closed exactly once (no silent " \
+        "retirement, no double close)"
+
+
+def test_scale_to_concurrent_growth_never_overshoots():
+    """Dynamic twin of the graftrace RC003 finding on
+    ReplicaRouter.scale_to: the grow path measured the plane under one
+    acquire and extended under another, so N concurrent scale_to(k)
+    calls could overshoot to ``1 + N*(k-1)`` replicas.  The publish
+    section now revalidates the room left before extending."""
+    import jax
+
+    stack = _SwapStack()
+    stack.batcher = None
+    stack.metric_names = ["c0_cpu"]
+    stack.window_size = W
+    stack.feature_dim = F
+    stack.quantiles = (0.5,)
+    stack.delta_mask = None
+    stack.median_index = lambda: 0
+
+    class _Lead:
+        def __init__(self, name, device):
+            self.name = name
+            self.device = device
+
+        def backend(self):
+            return stack
+
+        def drain(self):
+            pass
+
+        def close(self):
+            pass
+
+    # one seed replica per device so growth reuses stacks instead of
+    # cloning (the fake stack is not cloneable, and cloning is not what
+    # this hammer exercises)
+    seeds = [_Lead(f"r{i}", d) for i, d in enumerate(jax.devices())]
+    target = len(seeds) + 5
+    router = ReplicaRouter(seeds)
+    try:
+        threads = [threading.Thread(target=router.scale_to, args=(target,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(router.replicas) == target, \
+            "concurrent growth must cap at the requested size"
+    finally:
+        router.close()
+
+
 def test_autoscaler_measured_basis_scales_with_demand(pred8, traffic):
     mod = _load_autoscaler()
     router = ReplicaRouter.build(pred8, 1)
